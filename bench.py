@@ -1,0 +1,83 @@
+"""Headline benchmark: CIFAR-10 ResNet-18 training samples/sec/chip.
+
+The driver's scored metric (BASELINE.json): ResNet-18 on CIFAR-10,
+data-parallel training step, samples per second per chip. The reference
+publishes no numbers (SURVEY §6) — it only *instruments* avg per-batch
+wall-clock on 4-thread CPU ranks (``master/part1/part1.py:42-44``) — so
+the baseline here is the value this repo established in round 1 on one
+TPU v5e chip; ``vs_baseline`` tracks improvement against it.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+# Round-1 measured value on one TPU v5 lite chip (bf16, global batch 1024,
+# sync='auto'). Later rounds benchmark against this.
+ROUND1_BASELINE_SPS = None  # set after first TPU measurement
+
+GLOBAL_BATCH = 1024
+WARMUP_STEPS = 5
+MEASURE_STEPS = 30
+
+
+def main() -> None:
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    n_chips = len(jax.devices())
+    cfg = TrainConfig(
+        model="resnet18",
+        sync="auto",
+        num_devices=n_chips,
+        global_batch_size=GLOBAL_BATCH,
+        compute_dtype="bfloat16",
+        synthetic_data=True,
+    )
+    mesh = make_mesh({"data": n_chips})
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init()
+
+    ds = synthetic_cifar10(GLOBAL_BATCH, 16, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    key = jax.random.key(cfg.seed)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = trainer.train_step(state, x, y, key)
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = trainer.train_step(state, x, y, key)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - t0
+
+    sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed
+    sps_per_chip = sps / n_chips
+    vs = 1.0 if ROUND1_BASELINE_SPS is None else sps_per_chip / ROUND1_BASELINE_SPS
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_resnet18_train_samples_per_sec_per_chip",
+                "value": round(sps_per_chip, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""One CLI entrypoint for all parts.
+
+Replaces the reference's eight per-part, per-role scripts
+(``{master,slave}/part{1,2a,2b,3}/...``) launched as
+``python partN.py --master-ip IP --rank R --num-nodes N``
+(``master/part2a/part2a.py:136-143``) with a single command:
+
+    python -m cs744_pytorch_distributed_tutorial_tpu.cli --part 2b
+    python -m cs744_pytorch_distributed_tutorial_tpu.cli --sync p2p_star --num-devices 8
+
+Multi-host runs pass ``--coordinator/--num-processes/--process-id`` (the
+``init_process`` signature mirror); on Cloud TPU JAX autodetects all
+three. There is no master/slave split: every host runs the same program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from cs744_pytorch_distributed_tutorial_tpu.config import (
+    PART_PRESETS,
+    TrainConfig,
+    config_for_part,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cs744-tpu",
+        description="TPU-native data-parallel training (CS744 tutorial capabilities)",
+    )
+    p.add_argument("--part", choices=sorted(PART_PRESETS), default=None,
+                   help="reference part preset: sync strategy + world size")
+    p.add_argument("--sync", default=None,
+                   help="gradient sync strategy (overrides --part)")
+    p.add_argument("--model", default=None, help="model name (default vgg11)")
+    p.add_argument("--num-devices", type=int, default=None)
+    p.add_argument("--global-batch-size", type=int, default=None)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--momentum", type=float, default=None)
+    p.add_argument("--weight-decay", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--synthetic-data", action="store_true", default=None,
+                   help="force the synthetic CIFAR-10 stand-in")
+    p.add_argument("--synthetic-train-size", type=int, default=None)
+    p.add_argument("--synthetic-test-size", type=int, default=None)
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default=None)
+    p.add_argument("--log-every", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    # init_process mirror (master/part2a/part2a.py:80-85)
+    p.add_argument("--coordinator", dest="coordinator_address", default=None,
+                   help="coordinator address host:port (the --master-ip analog)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="the --num-nodes analog")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="the --rank analog")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host autodetect rendezvous (Cloud TPU pods): "
+                        "run jax.distributed.initialize() with no args")
+    p.add_argument("--json", action="store_true",
+                   help="print a final JSON summary line")
+    return p
+
+
+_ARG_TO_FIELD = {
+    "sync": "sync",
+    "model": "model",
+    "num_devices": "num_devices",
+    "global_batch_size": "global_batch_size",
+    "epochs": "epochs",
+    "lr": "learning_rate",
+    "momentum": "momentum",
+    "weight_decay": "weight_decay",
+    "seed": "seed",
+    "data_root": "data_root",
+    "synthetic_data": "synthetic_data",
+    "synthetic_train_size": "synthetic_train_size",
+    "synthetic_test_size": "synthetic_test_size",
+    "compute_dtype": "compute_dtype",
+    "log_every": "log_every",
+    "checkpoint_dir": "checkpoint_dir",
+    "coordinator_address": "coordinator_address",
+    "num_processes": "num_processes",
+    "process_id": "process_id",
+}
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    overrides = {
+        field: getattr(args, arg)
+        for arg, field in _ARG_TO_FIELD.items()
+        if getattr(args, arg) is not None
+    }
+    if args.part is not None:
+        return config_for_part(args.part, **overrides)
+    return TrainConfig(**overrides)
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+
+    # Rendezvous before touching devices (multi-host no-op otherwise).
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import initialize
+
+    initialize(
+        cfg.coordinator_address,
+        cfg.num_processes,
+        cfg.process_id,
+        auto=args.distributed,
+    )
+
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    trainer = Trainer(cfg)
+    state, history = trainer.fit()
+
+    if args.json and history["eval"]:
+        last = history["eval"][-1]
+        print(json.dumps({
+            "sync": cfg.sync,
+            "model": cfg.model,
+            "num_devices": trainer.axis_size,
+            "final_eval_loss": last["avg_loss"],
+            "final_eval_accuracy": last["accuracy"],
+            "avg_batch_time_s": history["avg_batch_time"],
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

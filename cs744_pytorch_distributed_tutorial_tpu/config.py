@@ -1,0 +1,102 @@
+"""Single dataclass config for the whole framework.
+
+Replaces the reference's scattered module-level constants and 3-flag
+argparse (``--master-ip``/``--num-nodes``/``--rank`` at
+``master/part2a/part2a.py:136-143``; ``batch_size`` at ``:20``; SGD
+hyperparameters at ``:127-128``; seed 5000 at ``:89``; hardcoded ports
+29501/29508 at ``part2a.py:83`` / ``part3.py:72``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Everything needed to reproduce a training run.
+
+    Defaults reproduce the reference workload: VGG-11 on CIFAR-10,
+    global batch 256, SGD lr=0.1 momentum=0.9 wd=1e-4, 1 epoch,
+    seed 5000 (``master/part1/part1.py:17,98-101,107``).
+    """
+
+    # Model / data
+    model: str = "vgg11"
+    num_classes: int = 10
+    image_size: int = 32
+    data_root: str = "./data"
+    synthetic_data: bool | None = None  # None = auto (synthetic if no local CIFAR-10)
+    synthetic_train_size: int = 50_000
+    synthetic_test_size: int = 10_000
+
+    # Optimization (reference: master/part1/part1.py:98-101)
+    global_batch_size: int = 256
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    epochs: int = 1
+    seed: int = 5000
+
+    # Parallelism
+    sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto
+    num_devices: int | None = None  # None = all visible devices
+    mesh_axes: dict[str, int] | None = None  # overrides num_devices; e.g. {"data": 4}
+
+    # Numerics: params/BN stats stay float32; compute dtype is the MXU knob.
+    compute_dtype: str = "float32"  # "bfloat16" on real TPU runs
+
+    # Logging / instrumentation (reference prints loss every 20 batches and
+    # the avg per-batch time over batches 1-10: master/part1/part1.py:39-44)
+    log_every: int = 20
+    timing_batches: tuple[int, int] = (1, 10)  # inclusive range averaged, step 0 (compile) excluded
+
+    # Multi-host rendezvous (mirrors init_process's signature,
+    # master/part2a/part2a.py:80-85; JAX derives process_id when None)
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+
+    # Checkpointing (capability addition — the reference has none, SURVEY §5.4)
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # steps; 0 = only at end when checkpoint_dir set
+
+    def replace(self, **kw: Any) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def per_device_batch_size(self) -> int:
+        n = self.num_devices
+        if n is None:  # None = all visible devices; resolve lazily
+            import jax
+
+            n = len(jax.devices())
+        if self.global_batch_size % n:
+            raise ValueError(
+                f"global_batch_size={self.global_batch_size} not divisible by "
+                f"num_devices={n}"
+            )
+        return self.global_batch_size // n
+
+
+# The four reference parts as config presets. Same model, same data, same
+# hyperparameters, four sync mechanisms — the pedagogical gradient the
+# reference builds (SURVEY §3.5). part1 is single-device batch 256
+# (part1.py:17); parts 2-3 are 64/rank x 4 ranks (part2a.py:20,32).
+PART_PRESETS: dict[str, dict[str, Any]] = {
+    "1": dict(sync="none", num_devices=1, global_batch_size=256),
+    "2a": dict(sync="gather_scatter", num_devices=4, global_batch_size=256),
+    "2a_extra": dict(sync="p2p_star", num_devices=4, global_batch_size=256),
+    "2b": dict(sync="allreduce", num_devices=4, global_batch_size=256),
+    "3": dict(sync="auto", num_devices=4, global_batch_size=256),
+}
+
+
+def config_for_part(part: str, **overrides: Any) -> TrainConfig:
+    """Build a config for one of the reference's parts (1, 2a, 2a_extra, 2b, 3)."""
+    if part not in PART_PRESETS:
+        raise ValueError(f"unknown part {part!r}; choose from {sorted(PART_PRESETS)}")
+    kw = dict(PART_PRESETS[part])
+    kw.update(overrides)
+    return TrainConfig(**kw)

@@ -1,0 +1,95 @@
+"""Model zoo and registry.
+
+The reference's zoo is one file exporting one factory
+(``master/part1/model.py:49-50``). Here: the full VGG table it defines
+plus the ResNet family the benchmark targets, behind a string registry
+so configs/CLI select models by name. ``tiny_cnn`` exists for fast CI on
+the forced-host CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cs744_pytorch_distributed_tutorial_tpu.models.resnet import (
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+)
+from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
+    VGG,
+    VGG_CFGS,
+    vgg11,
+    vgg13,
+    vgg16,
+    vgg19,
+)
+
+
+class TinyCNN(nn.Module):
+    """Small conv net with the same structural elements as VGG
+    (conv+BN+ReLU, pool, linear head) for fast tests."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        for feat in (8, 16):
+            x = nn.Conv(feat, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def tiny_cnn(**kw: Any) -> TinyCNN:
+    return TinyCNN(**kw)
+
+
+MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {
+    "vgg11": vgg11,
+    "vgg13": vgg13,
+    "vgg16": vgg16,
+    "vgg19": vgg19,
+    "resnet18": resnet18,
+    "resnet34": resnet34,
+    "resnet50": resnet50,
+    "tiny_cnn": tiny_cnn,
+}
+
+
+def get_model(name: str, **kw: Any) -> nn.Module:
+    try:
+        factory = MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; choose from {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return factory(**kw)
+
+
+__all__ = [
+    "MODEL_REGISTRY",
+    "get_model",
+    "ResNet",
+    "TinyCNN",
+    "VGG",
+    "VGG_CFGS",
+    "resnet18",
+    "resnet34",
+    "resnet50",
+    "tiny_cnn",
+    "vgg11",
+    "vgg13",
+    "vgg16",
+    "vgg19",
+]

@@ -1,0 +1,134 @@
+"""ResNet-{18,34,50} — the benchmark model family.
+
+The reference's only model is VGG-11, but the driver's scored metric is
+CIFAR-10 ResNet-18 samples/sec/chip and ResNet-50/ImageNet scale-out
+(``BASELINE.json``; SURVEY §6 notes the build needs both). Standard
+pre-activation-free ("v1.5") residual networks, written NHWC for the
+TPU's native conv layout, with a ``dtype`` knob for bfloat16 MXU compute
+(params/BN stats stay float32).
+
+Two stems:
+- ``cifar_stem=True`` (default for 32x32): single 3x3 conv, no maxpool —
+  the standard CIFAR ResNet adaptation;
+- ``cifar_stem=False``: ImageNet 7x7/stride-2 conv + 3x3 maxpool.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity/projection shortcut (ResNet-18/34)."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME")(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), padding="SAME")(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN gamma
+
+        if residual.shape != y.shape:
+            residual = conv(self.features, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with 4x expansion (ResNet-50+)."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding="SAME")(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: Callable[..., nn.Module]
+    num_classes: int = 10
+    cifar_stem: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        norm = partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+        )
+        if self.cifar_stem:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for b in range(n_blocks):
+                strides = 2 if stage > 0 and b == 0 else 1
+                x = self.block(features=64 * 2 ** stage, strides=strides,
+                               dtype=self.dtype)(x, train=train)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet18(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, **kw)
+
+
+def resnet34(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock, **kw)
+
+
+def resnet50(**kw: Any) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, **kw)

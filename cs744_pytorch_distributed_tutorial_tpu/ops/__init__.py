@@ -1,0 +1,7 @@
+"""Custom TPU ops (Pallas) — the framework's C++-analog layer.
+
+The reference has zero first-party native code; every native capability
+comes from libtorch/Gloo/torchvision (SURVEY §2.2). Here the equivalent
+layer is Mosaic-compiled Pallas kernels for ops worth hand-scheduling
+beyond XLA's fusions.
+"""

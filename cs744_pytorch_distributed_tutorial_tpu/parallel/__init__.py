@@ -1,0 +1,29 @@
+"""Parallelism: mesh construction, collectives, gradient-sync strategies.
+
+TPU-native replacement for the reference's torch.distributed/Gloo layer
+(SURVEY §2.2, §5.8).
+"""
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    initialize,
+    make_mesh,
+    replicated,
+)
+from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+    SYNC_STRATEGIES,
+    get_sync,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "initialize",
+    "make_mesh",
+    "replicated",
+    "SYNC_STRATEGIES",
+    "get_sync",
+]

@@ -1,0 +1,170 @@
+"""Collective primitives over the mesh — the Gloo replacement.
+
+The reference's entire communication story is Gloo over TCP:
+``new_group``, ``gather``, ``scatter``, ``all_reduce``, ``isend``,
+``irecv`` (``master/part2a/part2a.py:32,44,52``,
+``master/part2b/part2b.py:45``, ``master/part2a/part2a_extra.py:42-58``).
+This module supplies the XLA-collective equivalents, all meant to be
+called *inside* ``jax.shard_map``-ped jitted code over a named mesh axis:
+
+- ``all_reduce_mean``  <-> ``dist.all_reduce`` + divide (part2b)
+- ``gather_scatter_mean`` <-> gather-to-root, mean, scatter (part2a)
+- ``star_mean``        <-> the isend/irecv parameter-server star (part2a_extra)
+- ``ring_all_reduce``  — bandwidth-optimal ring over ``ppermute`` hops, the
+  TPU-idiomatic pattern (each hop is one ICI neighbor exchange; the same
+  primitive ring attention's kv rotation uses — SURVEY §5.7)
+- ``send_recv`` — the ``isend``/``irecv`` pair as one ``ppermute``
+
+Unlike Gloo ops, which execute eagerly per tensor between autograd and
+optimizer step, these are traced into the step's HLO: XLA's scheduler
+overlaps them with compute (what DDP's C++ bucketing reducer does by
+hand — ``master/part3/part3.py:116``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce_mean(x: jax.Array, axis_name: str) -> jax.Array:
+    """Mean over the mesh axis; ``p.grad /= N; dist.all_reduce(SUM)`` of
+    ``master/part2b/part2b.py:43-45`` as a single ``pmean``."""
+    return lax.pmean(x, axis_name)
+
+
+def all_reduce_sum(x: jax.Array, axis_name: str) -> jax.Array:
+    return lax.psum(x, axis_name)
+
+
+def gather_scatter_mean(x: jax.Array, axis_name: str) -> jax.Array:
+    """Gather-to-root -> mean at root -> scatter back (part2a semantics).
+
+    The reference does, per parameter: rank 0 ``dist.gather``s all 4
+    grads, sums/divides by 4, and ``dist.scatter``s the mean
+    (``master/part2a/part2a.py:43-52``). In SPMD the faithful
+    re-expression is an ``all_gather`` followed by the same mean on every
+    replica — the root's reduction is replicated instead of scattered,
+    which is how a gather+scatter round-trip collapses on a mesh. The
+    result is bit-identical to the reference's mean; the generalized
+    divisor is ``axis_size`` rather than the reference's hardcoded 4
+    (``part2a.py:49``).
+    """
+    gathered = lax.all_gather(x, axis_name)  # [axis_size, *x.shape]
+    return jnp.mean(gathered, axis=0)
+
+
+def star_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Parameter-server star built from point-to-point hops (part2a_extra).
+
+    The reference's worst-case-latency structure: rank 0 ``irecv``s each
+    worker's grad sequentially (each immediately ``.wait()``-ed, so fully
+    blocking), averages, then ``isend``s the mean back one worker at a
+    time (``master/part2a/part2a_extra.py:42-58``,
+    ``slave/part2a/part2a_extra.py:41-45``). Re-expressed with the only
+    p2p primitive idiomatic on ICI — ``lax.ppermute`` — as 2*(N-1)
+    sequential single-pair hops, preserving the serialized star shape the
+    tutorial uses to teach why collectives exist.
+
+    Devices not named in a ``ppermute`` permutation receive zeros, so the
+    collect phase accumulates with a plain add; selects by
+    ``lax.axis_index`` route the mean back out.
+    """
+    idx = lax.axis_index(axis_name)
+    acc = x
+    for k in range(1, axis_size):  # collect: rank k -> rank 0, one hop at a time
+        acc = acc + lax.ppermute(x, axis_name, perm=[(k, 0)])
+    mean = acc / axis_size  # meaningful at rank 0 only
+    out = jnp.where(idx == 0, mean, x)
+    for k in range(1, axis_size):  # distribute: rank 0 -> rank k
+        out = jnp.where(idx == k, lax.ppermute(mean, axis_name, perm=[(0, k)]), out)
+    return out
+
+
+def send_recv(x: jax.Array, axis_name: str, src: int, dst: int) -> jax.Array:
+    """One ``isend``/``irecv`` pair (``slave/part2a/part2a_extra.py:41-45``)
+    as a single-pair ``ppermute``: the value leaves ``src``, lands on
+    ``dst``; every other device receives zeros."""
+    return lax.ppermute(x, axis_name, perm=[(src, dst)])
+
+
+def ring_shift(x: jax.Array, axis_name: str, axis_size: int, shift: int = 1) -> jax.Array:
+    """Rotate values one (or ``shift``) neighbor(s) around the ring.
+
+    The neighbor-exchange primitive: on a TPU torus each hop is one ICI
+    link. This is the building block for ring allreduce below and for
+    ring attention's block rotation (SURVEY §5.7: build the primitive).
+    """
+    perm = [(i, (i + shift) % axis_size) for i in range(axis_size)]
+    return lax.ppermute(x, axis_name, perm=perm)
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Bandwidth-optimal ring allreduce: reduce-scatter + all-gather.
+
+    2*(N-1) neighbor hops moving ~2*|x|/N bytes each — the classic ring
+    the reference's Gloo backend implements in C++ for
+    ``dist.all_reduce``. Written out in ``ppermute`` hops both as the
+    pedagogically-faithful "what the backend actually does" and as the pattern
+    Pallas/async variants build on. Numerically equals ``psum``.
+
+    For production steps prefer ``lax.psum`` — XLA already lowers it to
+    the optimal ICI algorithm; this exists as the explicit-strategy
+    variant (SURVEY §7 layer 5).
+    """
+    n = axis_size
+    if n == 1:
+        return x
+    idx = lax.axis_index(axis_name)
+    orig_shape, orig_size = x.shape, x.size
+    pad = (-orig_size) % n
+    flat = jnp.pad(x.reshape(-1), (0, pad))
+    chunks = flat.reshape(n, -1)  # chunk c lives at row c
+
+    up = [(i, (i + 1) % n) for i in range(n)]
+
+    # Reduce-scatter: at step s, device i sends its running sum of chunk
+    # (i - s) mod n to neighbor i+1, which accumulates it into the same
+    # chunk row. After n-1 steps device i holds the full sum of chunk
+    # (i + 1) mod n.
+    def rs_step(s, chunks):
+        send_row = (idx - s) % n
+        payload = lax.dynamic_index_in_dim(chunks, send_row, axis=0, keepdims=False)
+        recvd = lax.ppermute(payload, axis_name, perm=up)
+        recv_row = (idx - s - 1) % n
+        current = lax.dynamic_index_in_dim(chunks, recv_row, axis=0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            chunks, current + recvd, recv_row, axis=0
+        )
+
+    chunks = lax.fori_loop(0, n - 1, rs_step, chunks)
+
+    # All-gather: rotate the completed chunks around the ring.
+    def ag_step(s, chunks):
+        send_row = (idx + 1 - s) % n
+        payload = lax.dynamic_index_in_dim(chunks, send_row, axis=0, keepdims=False)
+        recvd = lax.ppermute(payload, axis_name, perm=up)
+        recv_row = (idx - s) % n
+        return lax.dynamic_update_index_in_dim(chunks, recvd, recv_row, axis=0)
+
+    chunks = lax.fori_loop(0, n - 1, ag_step, chunks)
+
+    out = chunks.reshape(-1)
+    if pad:
+        out = out[:orig_size]
+    return out.reshape(orig_shape)
+
+
+def ring_all_reduce_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    return ring_all_reduce(x, axis_name, axis_size) / axis_size
+
+
+def tree_map_sync(fn, tree):
+    """Apply a per-leaf sync op over a gradient pytree — the SPMD analog of
+    the reference's ``for p in model.parameters():`` sync loops
+    (``master/part2a/part2a.py:42-52``). XLA fuses/overlaps the per-leaf
+    collectives; the Python loop only shapes the traced graph."""
+    return jax.tree.map(fn, tree)

@@ -1,0 +1,150 @@
+"""Device mesh construction and multi-host rendezvous.
+
+Replaces the reference's process-group layer: ``init_process()`` sets
+``MASTER_ADDR``/``MASTER_PORT`` and calls
+``dist.init_process_group('gloo', rank, world_size)``
+(``master/part2a/part2a.py:80-85``). On TPU the rendezvous is
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` —
+a direct signature mirror — and the "process group" is a
+``jax.sharding.Mesh`` laid out over ICI.
+
+Unlike the reference, which hardcodes the world ``[0, 1, 2, 3]``
+(``master/part2a/part2a.py:32``) and the divisor 4 in its averaging math
+even though ``--num-nodes`` is a CLI flag, everything here generalizes to
+``axis_size``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names. The reference only has data parallelism
+# (SURVEY §2.3); MODEL_AXIS exists so tensor-parallel shardings slot in
+# without reshaping the API.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    *,
+    auto: bool = False,
+) -> None:
+    """Multi-host rendezvous; the ``init_process`` equivalent.
+
+    Mirrors ``init_process(master_ip, rank, size, fn)`` at
+    ``master/part2a/part2a.py:80-85`` — but where Gloo needs
+    MASTER_ADDR/MASTER_PORT env vars and a TCPStore, JAX's coordination
+    service takes the coordinator address directly. On Cloud TPU pods
+    JAX can autodetect all three: pass ``auto=True`` (the CLI's
+    ``--distributed`` flag) to run the no-arg autodetect rendezvous.
+
+    With ``auto=False`` and no explicit args this is a no-op, so
+    single-process runs can call it unconditionally.
+    """
+    explicit = not (
+        coordinator_address is None and num_processes is None and process_id is None
+    )
+    if not (auto or explicit):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    axes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``axes`` maps axis name -> size, e.g. ``{"data": 4}`` for the
+    reference's 4-rank data-parallel world or ``{"data": 2, "model": 4}``
+    for a DP x TP grid. Default: a 1-D data mesh over all visible devices.
+
+    On real hardware ``jax.make_mesh`` orders devices so the innermost
+    axis rides the fastest ICI links; under
+    ``--xla_force_host_platform_device_count`` the same code runs on
+    virtual CPU devices (the reference's "4 CloudLab nodes" with no
+    cluster — SURVEY §4).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {DATA_AXIS: len(devices)}
+    names = tuple(axes.keys())
+    shape = tuple(int(s) for s in axes.values())
+    need = math.prod(shape)
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {dict(axes)} needs {need} devices, only {len(devices)} visible"
+        )
+    if need == len(devices) and len(set(d.platform for d in devices)) == 1:
+        try:
+            return jax.make_mesh(shape, names, devices=np.asarray(devices))
+        except TypeError:  # older signature without devices kwarg
+            pass
+    dev_array = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for fully replicated values (params, opt state)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for a global batch split along its leading dim.
+
+    The ``DistributedSampler`` analog at the array level: the reference
+    shards the *dataset* per rank (``master/part2a/part2a.py:107``); here
+    the global batch is one `jax.Array` whose leading dim is laid out
+    along the mesh's data axis.
+    """
+    return NamedSharding(mesh, P(axis))
+
+
+def device_stats_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for per-replica state (leading device axis), e.g. BatchNorm
+    running statistics.
+
+    The reference's DP keeps BN statistics local per rank — DDP's default,
+    and the manual parts never sync BN buffers (SURVEY §7 hard part b).
+    SPMD equivalent: store them with a leading ``[num_devices, ...]`` axis
+    sharded along ``data`` so each replica owns its own stats. Today this
+    is the same sharding as a data-sharded batch; it stays a named alias
+    so per-replica state can move to its own layout without touching
+    callers.
+    """
+    return batch_sharding(mesh, axis)
+
+
+def shard_global_batch(mesh: Mesh, *arrays: jax.Array | np.ndarray, axis: str = DATA_AXIS):
+    """Place host arrays as data-sharded global jax.Arrays."""
+    sharding = batch_sharding(mesh, axis)
+    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    return out[0] if len(out) == 1 else out
+
+
+def local_to_global_batch(mesh: Mesh, *arrays: np.ndarray, axis: str = DATA_AXIS):
+    """Assemble a global sharded array from per-process local shards.
+
+    Multi-host path: each host contributes its local slice (the
+    ``DistributedSampler`` equivalent across hosts), glued into one
+    global array via ``jax.make_array_from_process_local_data``.
+    """
+    sharding = batch_sharding(mesh, axis)
+    out = tuple(
+        jax.make_array_from_process_local_data(sharding, np.asarray(a)) for a in arrays
+    )
+    return out[0] if len(out) == 1 else out

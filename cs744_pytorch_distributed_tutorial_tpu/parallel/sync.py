@@ -1,0 +1,96 @@
+"""Gradient-sync strategies — the reference's four parts as plug-ins.
+
+The reference implements the same data-parallel semantics four times as
+copy-pasted scripts whose ONLY difference is the gradient-sync section of
+``train_model`` (SURVEY §2.1, §3.5):
+
+=================  =============================================  =====================
+strategy           reference                                      mechanism here
+=================  =============================================  =====================
+``none``           part1 (single process, no comm)                identity
+``gather_scatter`` part2a  (``master/part2a/part2a.py:42-52``)    all_gather -> mean
+``p2p_star``       part2a_extra (``part2a_extra.py:41-58``)       sequential ppermute star
+``allreduce``      part2b  (``master/part2b/part2b.py:43-45``)    in-graph pmean
+``ring``           (TPU-native explicit variant)                  ppermute ring allreduce
+``auto``           part3 DDP (``master/part3/part3.py:116``)      engine-inserted pmean
+=================  =============================================  =====================
+
+A strategy is ``fn(grads_pytree, axis_name, axis_size) -> grads_pytree``,
+applied per-leaf inside the jitted train step under ``shard_map`` — the
+SPMD analog of the reference's ``for p in model.parameters():`` loops.
+All strategies compute the same mean; they differ (deliberately) in the
+communication structure traced into the graph. ``auto`` is special-cased
+by the engine: like DDP, the user-visible step has *no* explicit comm and
+the framework inserts the averaging itself.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import jax
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel import collectives as C
+
+SyncFn = Callable[[jax.Array, str, int], jax.Array]
+
+
+def _none(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """part1: single-process, no communication (``master/part1/part1.py``)."""
+    return g
+
+
+def _allreduce(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """part2b: pre-divide + all_reduce(SUM) == pmean
+    (``master/part2b/part2b.py:43-45``, divisor generalized from the
+    hardcoded 4 to ``axis_size``)."""
+    return C.all_reduce_mean(g, axis_name)
+
+
+def _gather_scatter(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """part2a: gather at rank 0, mean, scatter back
+    (``master/part2a/part2a.py:42-52``)."""
+    return C.gather_scatter_mean(g, axis_name)
+
+
+def _p2p_star(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """part2a_extra: the fully-serialized isend/irecv parameter-server star
+    (``master/part2a/part2a_extra.py:41-58``)."""
+    return C.star_mean(g, axis_name, axis_size)
+
+
+def _ring(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
+    """Explicit bandwidth-optimal ring allreduce over ppermute hops."""
+    return C.ring_all_reduce_mean(g, axis_name, axis_size)
+
+
+# ``auto`` maps to allreduce numerics; the engine treats it as "framework
+# inserts the sync" (DDP automation) rather than a user-plugged loop.
+SYNC_STRATEGIES: dict[str, SyncFn] = {
+    "none": _none,
+    "allreduce": _allreduce,
+    "gather_scatter": _gather_scatter,
+    "p2p_star": _p2p_star,
+    "ring": _ring,
+    "auto": _allreduce,
+}
+
+#: Strategies whose outputs the VMA replication checker cannot statically
+#: prove replicated (axis_index-routed selects; ``all_gather`` outputs),
+#: so the enclosing ``shard_map`` needs ``check_vma=False``.
+UNCHECKED_REPLICATION = {"p2p_star", "ring", "gather_scatter"}
+
+
+def get_sync(name: str) -> SyncFn:
+    try:
+        return SYNC_STRATEGIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown sync strategy {name!r}; choose from {sorted(SYNC_STRATEGIES)}"
+        ) from None
+
+
+def sync_grads(grads, name: str, axis_name: str, axis_size: int):
+    """Apply strategy ``name`` leaf-wise over a gradient pytree."""
+    fn = get_sync(name)
+    return C.tree_map_sync(lambda g: fn(g, axis_name, axis_size), grads)

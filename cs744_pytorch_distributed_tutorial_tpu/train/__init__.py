@@ -1,0 +1,6 @@
+"""The training engine: one SPMD trainer, pluggable sync strategies."""
+
+from cs744_pytorch_distributed_tutorial_tpu.train.state import TrainState, make_optimizer
+from cs744_pytorch_distributed_tutorial_tpu.train.engine import Trainer
+
+__all__ = ["TrainState", "make_optimizer", "Trainer"]

@@ -1,0 +1,360 @@
+"""The single SPMD training engine.
+
+The reference implements its trainer five times (part1, part2a,
+part2a_extra, part2b, part3) as copy-pasted scripts differing only in the
+gradient-sync section of ``train_model`` and duplicated again across
+``master/`` and ``slave/`` trees (SURVEY §1). Here there is ONE engine:
+a jitted ``shard_map``-ped train step over a named device mesh, with the
+sync strategy plugged in (``parallel/sync.py``). Rank asymmetry lives in
+collective semantics, not in parallel source trees.
+
+Step anatomy (all traced into one XLA program — XLA's latency-hiding
+scheduler overlaps the collectives with compute, which is what DDP's C++
+bucketing reducer does by hand, ``master/part3/part3.py:116``):
+
+1. on-device augmentation of the local uint8 batch shard (``data/augment``);
+2. forward + loss (CrossEntropy, mean over local shard) with local
+   BatchNorm batch statistics — reference DP semantics;
+3. ``jax.grad`` (replaces tape autograd + ``loss.backward()``);
+4. strategy-supplied gradient averaging over the ``data`` axis;
+5. SGD(momentum, wd) update — replicated, since synced grads are equal.
+
+The ``auto`` strategy is the DDP analog: the user-facing step has no
+explicit communication and the engine inserts the averaging itself
+(part3: ``DDP(model)`` + a comm-free train loop,
+``master/part3/part3.py:34-48,116``). The manual strategies trace their
+collectives explicitly per parameter, mirroring the reference's
+``for p in model.parameters():`` loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.data import BatchLoader, load_cifar10
+from cs744_pytorch_distributed_tutorial_tpu.data.augment import (
+    augment_train_batch,
+    eval_batch,
+)
+from cs744_pytorch_distributed_tutorial_tpu.models import get_model
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    device_stats_sharding,
+    make_mesh,
+    replicated,
+)
+from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+    UNCHECKED_REPLICATION,
+    get_sync,
+    sync_grads,
+)
+from cs744_pytorch_distributed_tutorial_tpu.train.state import (
+    TrainState,
+    init_state,
+    make_optimizer,
+)
+from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
+from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+class Trainer:
+    """One engine, pluggable sync strategies (SURVEY §7 design stance)."""
+
+    def __init__(self, cfg: TrainConfig, mesh=None):
+        self.cfg = cfg
+        if mesh is None:
+            axes = cfg.mesh_axes or {DATA_AXIS: cfg.num_devices or len(jax.devices())}
+            mesh = make_mesh(axes)
+        self.mesh = mesh
+        self.axis_size = mesh.shape[DATA_AXIS]
+        if cfg.sync == "none" and self.axis_size > 1:
+            raise ValueError(
+                "sync='none' (part1 semantics) requires a single-device data axis; "
+                f"got {self.axis_size}. Pick a sync strategy or shrink the mesh."
+            )
+        if cfg.global_batch_size % self.axis_size:
+            raise ValueError(
+                f"global batch {cfg.global_batch_size} not divisible by "
+                f"data-axis size {self.axis_size}"
+            )
+        self.model = get_model(
+            cfg.model, num_classes=cfg.num_classes, dtype=_DTYPES[cfg.compute_dtype]
+        )
+        self.tx = make_optimizer(cfg)
+        self.log = get_logger()
+        self._sync_fn = get_sync(cfg.sync)
+        self._check_vma = cfg.sync not in UNCHECKED_REPLICATION
+        self._build_steps()
+
+    # ------------------------------------------------------------------ build
+    def _state_specs(self) -> TrainState:
+        return TrainState(
+            step=P(), params=P(), batch_stats=P(DATA_AXIS), opt_state=P()
+        )
+
+    def _build_steps(self) -> None:
+        cfg, model, tx = self.cfg, self.model, self.tx
+        axis_size, sync_fn = self.axis_size, self._sync_fn
+
+# Whether gradient averaging is inserted by the framework (the DDP
+        # analog) or traced explicitly by the plugged strategy. Key VMA
+        # subtlety: under shard_map's replication analysis, differentiating
+        # a device-varying loss w.r.t. *replicated* (unvarying) params makes
+        # the autodiff transpose insert a psum automatically — grads arrive
+        # already globally reduced. The two paths map exactly onto the
+        # reference's pedagogy:
+        #  - 'auto' (part3/DDP): differentiate the pmean'd global loss and
+        #    let the AD transpose insert the collective — communication the
+        #    user never writes, exactly DDP's contract
+        #    (master/part3/part3.py:34-48,116). 'none' (part1) rides the
+        #    same path on a 1-sized axis, where pmean is a no-op.
+        #  - manual strategies (part2a/2a_extra/2b): pcast params to
+        #    device-varying first, so grads come out purely LOCAL (the state
+        #    after the reference's loss.backward() and before its sync
+        #    loop), then the strategy's explicit collectives average them.
+        framework_inserted_sync = cfg.sync in ("auto", "none")
+
+        def local_train_step(state: TrainState, images, labels, base_key):
+            # Per-device, per-step augmentation randomness: fold the run key
+            # with the step and the replica index (the DistributedSampler
+            # seed-discipline analog, master/part2a/part2a.py:89-90).
+            key = jax.random.fold_in(base_key, state.step)
+            key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+            x = augment_train_batch(key, images)
+
+            local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
+
+            def local_loss_fn(params):
+                logits, mutated = model.apply(
+                    {"params": params, "batch_stats": local_stats},
+                    x,
+                    train=True,
+                    mutable=["batch_stats"],
+                )
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, labels
+                ).mean()
+                return loss, mutated["batch_stats"]
+
+            if framework_inserted_sync:
+
+                def global_loss_fn(params):
+                    local, new_stats = local_loss_fn(params)
+                    return lax.pmean(local, DATA_AXIS), (local, new_stats)
+
+                (loss, (local_loss, new_stats)), grads = jax.value_and_grad(
+                    global_loss_fn, has_aux=True
+                )(state.params)
+            else:
+                params_local = jax.tree.map(
+                    lambda p: lax.pcast(p, DATA_AXIS, to="varying"), state.params
+                )
+                (local_loss, new_stats), grads = jax.value_and_grad(
+                    local_loss_fn, has_aux=True
+                )(params_local)
+                grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
+                loss = lax.pmean(local_loss, DATA_AXIS)
+
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss,  # global mean for logging
+                "local_loss": local_loss[None],  # [1]/replica -> [axis_size]
+            }
+            new_state = TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=jax.tree.map(lambda a: a[None], new_stats),
+                opt_state=new_opt,
+            )
+            return new_state, metrics
+
+        state_specs = self._state_specs()
+        metric_specs = {"loss": P(), "local_loss": P(DATA_AXIS)}
+
+        mapped_train = jax.shard_map(
+            local_train_step,
+            mesh=self.mesh,
+            in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=(state_specs, metric_specs),
+            check_vma=self._check_vma,
+        )
+        self.train_step = jax.jit(mapped_train, donate_argnums=0)
+
+        def local_eval_step(state: TrainState, images, labels, mask):
+            """Eval on the local shard with the replica's own running BN
+            stats; loss/correct counts reduced with psum — the working
+            version of the reference's dead ``isend`` of ``correct`` to
+            rank 0 that master never receives
+            (``slave/part2b/part2b.py:67-69``, SURVEY §2.1 #6). ``mask``
+            (1.0 real / 0.0 padding) keeps batch shapes static on any
+            mesh while counting each test example exactly once."""
+            local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
+            logits = model.apply(
+                {"params": state.params, "batch_stats": local_stats},
+                eval_batch(images),
+                train=False,
+            )
+            losses = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+            correct = ((jnp.argmax(logits, axis=-1) == labels) * mask).sum()
+            return {
+                "loss_sum": lax.psum((losses * mask).sum(), DATA_AXIS),
+                "correct": lax.psum(correct, DATA_AXIS),
+                "count": lax.psum(mask.sum(), DATA_AXIS),
+            }
+
+        mapped_eval = jax.shard_map(
+            local_eval_step,
+            mesh=self.mesh,
+            in_specs=(state_specs, P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs={"loss_sum": P(), "correct": P(), "count": P()},
+            check_vma=self._check_vma,
+        )
+        self.eval_step = jax.jit(mapped_eval)
+
+    # ------------------------------------------------------------------ state
+    def init(self, seed: int | None = None) -> TrainState:
+        cfg = self.cfg
+        rng = jax.random.key(cfg.seed if seed is None else seed)
+        sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+        state = init_state(self.model, self.tx, rng, sample, self.axis_size)
+        return self.place_state(state)
+
+    def place_state(self, state: TrainState) -> TrainState:
+        """Lay the state out on the mesh: replicated params/opt, per-replica
+        BN stats along the data axis."""
+        rep = replicated(self.mesh)
+        dev = device_stats_sharding(self.mesh)
+        return TrainState(
+            step=jax.device_put(state.step, rep),
+            params=jax.device_put(state.params, rep),
+            batch_stats=jax.device_put(state.batch_stats, dev),
+            opt_state=jax.device_put(state.opt_state, rep),
+        )
+
+    # ------------------------------------------------------------------ loops
+    def fit(
+        self,
+        dataset=None,
+        state: TrainState | None = None,
+        epochs: int | None = None,
+    ) -> tuple[TrainState, dict[str, Any]]:
+        """Full training run: the reference's epoch loop
+        (``master/part1/part1.py:101-103``) with its three signals —
+        loss every ``log_every`` batches, average per-batch time over the
+        timing window, eval summary after each epoch."""
+        cfg = self.cfg
+        if dataset is None:
+            dataset = load_cifar10(
+                cfg.data_root,
+                synthetic=cfg.synthetic_data,
+                synthetic_train_size=cfg.synthetic_train_size,
+                synthetic_test_size=cfg.synthetic_test_size,
+            )
+        train_loader = BatchLoader(
+            dataset.train_images,
+            dataset.train_labels,
+            cfg.global_batch_size,
+            mesh=self.mesh,
+            shuffle=True,
+            seed=cfg.seed,
+        )
+        test_loader = BatchLoader(
+            dataset.test_images,
+            dataset.test_labels,
+            cfg.global_batch_size,
+            mesh=self.mesh,
+            shuffle=False,
+            drop_last=False,
+        )
+        if state is None:
+            state = self.init()
+        base_key = jax.device_put(
+            jax.random.key(cfg.seed), replicated(self.mesh)
+        )
+
+        history: dict[str, Any] = {"train_loss": [], "eval": [], "avg_batch_time": None}
+        timer = StepTimer(window=cfg.timing_batches)
+        ckpt = None
+        start_epoch = 0
+        steps_done = 0
+        steps_per_epoch = len(train_loader)
+        if cfg.checkpoint_dir:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
+                Checkpointer,
+            )
+
+            ckpt = Checkpointer(cfg.checkpoint_dir)
+            restored = ckpt.restore_latest(state)
+            if restored is not None:
+                state = self.place_state(restored)
+                steps_done = int(jax.device_get(state.step))
+                start_epoch = steps_done // max(steps_per_epoch, 1)
+                self.log.info(
+                    "restored checkpoint at step %d (resuming at epoch %d)",
+                    steps_done,
+                    start_epoch,
+                )
+
+        for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
+            timer.start()
+            for batch_idx, (x, y) in enumerate(train_loader.epoch(epoch)):
+                state, metrics = self.train_step(state, x, y, base_key)
+                # Block on the loss only while timing or logging needs the
+                # value — otherwise leave dispatch fully async so the host
+                # stages batch N+1 while the device runs batch N.
+                timing_active = timer.steps_recorded <= cfg.timing_batches[1]
+                should_log = batch_idx % cfg.log_every == 0
+                if timing_active or should_log:
+                    loss = jax.block_until_ready(metrics["loss"])
+                if timing_active:
+                    timer.tick()
+                    if timer.steps_recorded == cfg.timing_batches[1] + 1:
+                        avg = timer.window_average()
+                        history["avg_batch_time"] = avg
+                        self.log.info("average time:  %f", avg)
+                if should_log:
+                    loss_val = float(loss)
+                    history["train_loss"].append((epoch, batch_idx, loss_val))
+                    self.log.info("%d loss:  %f", batch_idx, loss_val)
+                steps_done += 1
+                if ckpt and cfg.checkpoint_every and steps_done % cfg.checkpoint_every == 0:
+                    ckpt.save(state)
+            eval_metrics = self.evaluate(state, test_loader)
+            history["eval"].append(eval_metrics)
+            self.log.info(
+                "Test set: Average loss: %.4f, Accuracy: %d/%d (%.0f%%)",
+                eval_metrics["avg_loss"],
+                eval_metrics["correct"],
+                eval_metrics["count"],
+                100.0 * eval_metrics["accuracy"],
+            )
+        if ckpt is not None:
+            ckpt.save(state, force=True)
+        return state, history
+
+    def evaluate(self, state: TrainState, test_loader: BatchLoader) -> dict[str, float]:
+        total_loss, total_correct, total_count = 0.0, 0, 0
+        for x, y, mask in test_loader.epoch_padded(0):
+            m = self.eval_step(state, x, y, mask)
+            total_loss += float(m["loss_sum"])
+            total_correct += int(m["correct"])
+            total_count += int(m["count"])
+        return {
+            "avg_loss": total_loss / max(total_count, 1),
+            "correct": total_correct,
+            "count": total_count,
+            "accuracy": total_correct / max(total_count, 1),
+        }

@@ -1,0 +1,71 @@
+"""Train state pytree and the reference optimizer.
+
+State layout on the mesh:
+- ``params`` / ``opt_state``: replicated (each data-parallel replica holds
+  the full model, as in the reference — no ZeRO sharding, SURVEY §2.3);
+- ``batch_stats``: per-replica with a leading ``[num_devices, ...]`` axis
+  sharded along ``data``. The reference's DP keeps BatchNorm statistics
+  local per rank (DDP default; the manual parts never sync BN buffers),
+  so replica i's running stats live at index i (SURVEY §7 hard part b).
+
+Optimizer: SGD lr=0.1, momentum=0.9, weight_decay=1e-4 — the reference's
+exact update rule (``master/part1/part1.py:98-99``). torch-SGD semantics:
+decay is added to the gradient BEFORE the momentum buffer update
+(grad += wd*p; buf = mu*buf + grad; p -= lr*buf), which is the optax
+chain add_decayed_weights -> trace -> scale(-lr).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array  # scalar int32
+    params: Any
+    batch_stats: Any  # leading [num_devices, ...] axis
+    opt_state: Any
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.add_decayed_weights(cfg.weight_decay),
+        optax.trace(decay=cfg.momentum, nesterov=False),
+        optax.scale(-cfg.learning_rate),
+    )
+
+
+def init_state(
+    model,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    sample_input: jax.Array,
+    num_devices: int,
+) -> TrainState:
+    """Initialize params/BN stats/optimizer state on host.
+
+    All replicas start from the same initialization — the behavior DDP
+    gets by broadcasting rank-0 parameters at construction
+    (``master/part3/part3.py:116``); with a single PRNG key it holds by
+    construction. BN stats are tiled to ``[num_devices, ...]``.
+    """
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tiled_stats = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (num_devices, *x.shape)), batch_stats
+    )
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=tiled_stats,
+        opt_state=tx.init(params),
+    )

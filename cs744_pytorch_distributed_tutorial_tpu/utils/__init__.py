@@ -1,0 +1,6 @@
+"""Utilities: logging, step timing, checkpointing."""
+
+from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger, rank_zero_only
+from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
+
+__all__ = ["get_logger", "rank_zero_only", "StepTimer"]

@@ -1,0 +1,45 @@
+"""Structured per-host logging with rank-0 summaries.
+
+The reference logs with bare ``print()`` on every rank independently —
+loss every 20 batches, average batch time, eval summary
+(``master/part1/part1.py:40,44,60-62``) — and imports ``logging`` without
+ever using it (``part1.py:10``). Here: a real logger, prefixed with the
+process index on multi-host runs, plus a ``rank_zero_only`` guard so
+global summaries print once.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from functools import wraps
+
+import jax
+
+def get_logger(name: str = "cs744_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        prefix = (
+            f"[proc {jax.process_index()}/{jax.process_count()}] "
+            if jax.process_count() > 1
+            else ""
+        )
+        handler.setFormatter(logging.Formatter(f"{prefix}%(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def rank_zero_only(fn):
+    """Run ``fn`` only on process 0 — the reference expresses this as a
+    whole separate ``master/`` source tree (SURVEY §1)."""
+
+    @wraps(fn)
+    def wrapper(*args, **kwargs):
+        if jax.process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapper

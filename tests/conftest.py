@@ -1,0 +1,43 @@
+"""Test harness: multi-device-without-a-cluster.
+
+The reference's verification strategy was "run on 4 CloudLab nodes and
+eyeball the loss" (SURVEY §4). Here every collective path runs
+single-process in CI on 8 virtual CPU devices via
+``--xla_force_host_platform_device_count`` — set BEFORE the XLA backend
+initializes. The environment's sitecustomize force-selects the TPU
+('axon') platform via ``jax.config``, so we must override the config, not
+just the env var.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 forced CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+
+    return make_mesh({"data": 4}, devices=jax.devices()[:4])
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+
+    return make_mesh({"data": 8})

@@ -1,0 +1,48 @@
+"""CLI: preset/flag plumbing and a tiny end-to-end run."""
+
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.cli import build_parser, config_from_args, main
+from cs744_pytorch_distributed_tutorial_tpu.config import config_for_part
+
+
+def _cfg(argv):
+    return config_from_args(build_parser().parse_args(argv))
+
+
+def test_part_presets_map_to_reference():
+    """SURVEY §2.1: part -> sync mechanism, world 4, global batch 256."""
+    assert _cfg(["--part", "1"]).sync == "none"
+    assert _cfg(["--part", "2a"]).sync == "gather_scatter"
+    assert _cfg(["--part", "2a_extra"]).sync == "p2p_star"
+    assert _cfg(["--part", "2b"]).sync == "allreduce"
+    cfg3 = _cfg(["--part", "3"])
+    assert cfg3.sync == "auto"
+    assert cfg3.num_devices == 4
+    assert cfg3.global_batch_size == 256
+    assert cfg3.per_device_batch_size == 64  # 64/rank (part2a.py:20)
+
+
+def test_overrides_beat_preset():
+    cfg = _cfg(["--part", "2b", "--sync", "ring", "--num-devices", "8",
+                "--lr", "0.01"])
+    assert cfg.sync == "ring"
+    assert cfg.num_devices == 8
+    assert cfg.learning_rate == 0.01
+
+
+def test_bad_part_rejected():
+    with pytest.raises(ValueError):
+        config_for_part("4")
+
+
+def test_cli_end_to_end(capsys):
+    rc = main([
+        "--part", "2b", "--model", "tiny_cnn", "--num-devices", "2",
+        "--global-batch-size", "16", "--synthetic-data",
+        "--synthetic-train-size", "64", "--synthetic-test-size", "16",
+        "--json",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert '"final_eval_accuracy"' in out

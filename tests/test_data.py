@@ -1,0 +1,155 @@
+"""Data pipeline: sampler contract, loader shapes, augmentation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.data import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    BatchLoader,
+    ShardedSampler,
+    load_cifar10,
+    synthetic_cifar10,
+)
+from cs744_pytorch_distributed_tutorial_tpu.data.augment import (
+    augment_train_batch,
+    eval_batch,
+    random_crop_flip,
+)
+
+
+# ----------------------------------------------------------------- sampler
+def test_sampler_shards_disjoint_and_cover():
+    """DistributedSampler contract (master/part2a/part2a.py:107): equal
+    sizes, disjoint, union covers the dataset (with wrap-around pad)."""
+    n, shards = 103, 4
+    all_idx = []
+    sizes = set()
+    for s in range(shards):
+        idx = ShardedSampler(n, shards, s, seed=7).indices(epoch=0)
+        sizes.add(len(idx))
+        all_idx.append(idx)
+    assert sizes == {26}  # ceil(103/4)
+    union = np.concatenate(all_idx)
+    assert set(union.tolist()) == set(range(n))
+
+
+def test_sampler_epoch_reshuffles_deterministically():
+    s = ShardedSampler(100, 2, 0, seed=1)
+    e0a, e0b = s.indices(epoch=0), s.indices(epoch=0)
+    e1 = s.indices(epoch=1)
+    np.testing.assert_array_equal(e0a, e0b)
+    assert not np.array_equal(e0a, e1)
+
+
+def test_sampler_no_shuffle_is_strided():
+    idx = ShardedSampler(8, 2, 1, shuffle=False).indices(0)
+    np.testing.assert_array_equal(idx, [1, 3, 5, 7])
+
+
+def test_sampler_drop_last():
+    s = ShardedSampler(103, 4, 0, drop_last=True)
+    assert len(s) == 25
+
+
+# ----------------------------------------------------------------- dataset
+def test_synthetic_deterministic_and_learnable_structure():
+    a = synthetic_cifar10(100, 20, seed=0)
+    b = synthetic_cifar10(100, 20, seed=0)
+    np.testing.assert_array_equal(a.train_images, b.train_images)
+    assert a.train_images.shape == (100, 32, 32, 3)
+    assert a.train_images.dtype == np.uint8
+    assert a.train_labels.dtype == np.int32
+    # class structure: same-class images closer than cross-class on average
+    same = cross = 0.0
+    imgs = a.train_images.astype(np.float32)
+    lab = a.train_labels
+    c0 = imgs[lab == lab[0]]
+    cX = imgs[lab != lab[0]]
+    if len(c0) > 1 and len(cX) > 0:
+        same = np.abs(c0[0] - c0[1]).mean()
+        cross = np.abs(c0[0] - cX[0]).mean()
+        assert same < cross
+
+
+def test_load_cifar10_auto_falls_back(tmp_path):
+    ds = load_cifar10(str(tmp_path), synthetic_train_size=64, synthetic_test_size=16)
+    assert ds.synthetic
+    assert len(ds.train_images) == 64
+
+
+def test_load_cifar10_strict_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_cifar10(str(tmp_path), synthetic=False)
+
+
+def test_load_cifar10_reads_pickle_format(tmp_path):
+    """Write a miniature cifar-10-batches-py tree and read it back."""
+    import os
+    import pickle
+
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for name, n in [(f"data_batch_{i}", 10) for i in range(1, 6)] + [("test_batch", 10)]:
+        data = rng.integers(0, 256, size=(n, 3072), dtype=np.uint8)
+        labels = rng.integers(0, 10, size=n).tolist()
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+    ds = load_cifar10(str(tmp_path))
+    assert not ds.synthetic
+    assert ds.train_images.shape == (50, 32, 32, 3)
+    assert ds.test_images.shape == (10, 32, 32, 3)
+
+
+# ----------------------------------------------------------------- augment
+def test_normalize_matches_reference_constants():
+    x = jnp.full((1, 32, 32, 3), 255, jnp.uint8)
+    out = np.asarray(eval_batch(x))
+    expected = (1.0 - CIFAR10_MEAN) / CIFAR10_STD
+    np.testing.assert_allclose(out[0, 0, 0], expected, rtol=1e-5)
+
+
+def test_crop_flip_shapes_and_determinism():
+    imgs = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (4, 32, 32, 3), dtype=np.uint8)
+    )
+    key = jax.random.key(0)
+    a = random_crop_flip(key, imgs)
+    b = random_crop_flip(key, imgs)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == imgs.shape
+    c = random_crop_flip(jax.random.key(1), imgs)
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_augment_train_batch_is_normalized():
+    imgs = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (8, 32, 32, 3), dtype=np.uint8)
+    )
+    out = np.asarray(augment_train_batch(jax.random.key(0), imgs))
+    assert out.dtype == np.float32
+    assert -3.5 < out.mean() < 3.5
+
+
+# ----------------------------------------------------------------- loader
+def test_batch_loader_shapes(mesh4):
+    ds = synthetic_cifar10(64, 16, seed=0)
+    loader = BatchLoader(ds.train_images, ds.train_labels, 16, mesh=mesh4, seed=0)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 4 == len(loader)
+    x, y = batches[0]
+    assert x.shape == (16, 32, 32, 3)
+    assert y.shape == (16,)
+    # sharded along data axis
+    assert x.sharding.spec == jax.sharding.PartitionSpec("data")
+
+
+def test_batch_loader_epoch_determinism(mesh4):
+    ds = synthetic_cifar10(64, 16, seed=0)
+    loader = BatchLoader(ds.train_images, ds.train_labels, 16, mesh=mesh4, seed=0)
+    a = [np.asarray(x)[0, 0, 0, 0] for x, _ in loader.epoch(0)]
+    b = [np.asarray(x)[0, 0, 0, 0] for x, _ in loader.epoch(0)]
+    assert a == b

@@ -1,0 +1,103 @@
+"""Model zoo: shapes, parameter counts, determinism.
+
+The reference has no model tests; its implicit check is the architecture
+table itself (``master/part1/model.py:3-8``). Here the VGG-11 parameter
+count is verified analytically against that table: conv(3x3, bias) +
+BN(scale, bias) per entry, Linear(512,10) head. BN running statistics are
+state, not parameters.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.models import (
+    MODEL_REGISTRY,
+    VGG_CFGS,
+    get_model,
+)
+
+
+def _n_params(tree):
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def _vgg_expected_params(cfg, num_classes=10):
+    total, in_ch = 0, 3
+    for entry in cfg:
+        if entry == "M":
+            continue
+        total += 3 * 3 * in_ch * entry + entry  # conv kernel + bias
+        total += 2 * entry  # BN scale + bias
+        in_ch = entry
+    total += 512 * num_classes + num_classes  # linear head
+    return total
+
+
+@pytest.mark.parametrize("name", ["vgg11", "vgg13", "vgg16", "vgg19"])
+def test_vgg_param_count(name):
+    model = get_model(name)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    assert _n_params(variables["params"]) == _vgg_expected_params(VGG_CFGS[name])
+
+
+@pytest.mark.parametrize("name", ["vgg11", "resnet18", "tiny_cnn"])
+def test_forward_shapes(name):
+    model = get_model(name)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    logits = model.apply(variables, x)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_vgg_flatten_is_512():
+    """32x32 through 5 maxpools -> 1x1x512, the reference's
+    flatten_features=512 (model.py:39-40)."""
+    model = get_model("vgg11")
+    x = jnp.zeros((1, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    # Dense kernel input dim encodes the flattened feature count.
+    dense = [v for k, v in variables["params"].items() if "Dense" in k]
+    assert dense[0]["kernel"].shape == (512, 10)
+
+
+def test_train_mode_updates_batch_stats():
+    model = get_model("tiny_cnn")
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    old = jax.tree.leaves(variables["batch_stats"])
+    new = jax.tree.leaves(mutated["batch_stats"])
+    assert any(not np.allclose(o, n) for o, n in zip(old, new))
+
+
+def test_bfloat16_compute_float32_params():
+    model = get_model("tiny_cnn", dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(variables["params"]))
+    logits = model.apply(variables, x)
+    assert logits.dtype == jnp.float32
+
+
+def test_forward_deterministic():
+    model = get_model("tiny_cnn")
+    x = jax.random.normal(jax.random.key(2), (2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    a = model.apply(variables, x)
+    b = model.apply(variables, x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_registry_rejects_unknown():
+    with pytest.raises(ValueError):
+        get_model("alexnet")
+
+
+def test_resnet_imagenet_stem():
+    model = get_model("resnet18", cifar_stem=False, num_classes=1000)
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x)
+    assert model.apply(variables, x).shape == (1, 1000)

@@ -1,0 +1,95 @@
+"""Gradient-sync strategy parity.
+
+The reference's central (implicit) property: part2a, part2a_extra, part2b
+and part3 compute the SAME update — four mechanisms, one semantics —
+which it establishes only by fixed seed + eyeballing loss curves
+(SURVEY §4). Here it is a real test: from identical init and an identical
+global batch, one train step under every strategy must produce identical
+parameters.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+STRATEGIES = ["allreduce", "gather_scatter", "p2p_star", "ring", "auto"]
+
+
+def _one_step_params(strategy, mesh, batch):
+    cfg = TrainConfig(
+        model="tiny_cnn",
+        sync=strategy,
+        num_devices=4,
+        global_batch_size=16,
+        seed=5000,
+    )
+    tr = Trainer(cfg, mesh=mesh)
+    state = tr.init()
+    x, y = batch
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import shard_global_batch
+
+    gx, gy = shard_global_batch(mesh, x, y)
+    key = jax.random.key(cfg.seed)
+    new_state, metrics = tr.train_step(state, gx, gy, key)
+    return (
+        jax.tree.map(np.asarray, jax.device_get(new_state.params)),
+        float(metrics["loss"]),
+    )
+
+
+@pytest.fixture(scope="module")
+def batch():
+    ds = synthetic_cifar10(64, 16, seed=3)
+    return ds.train_images[:16], ds.train_labels[:16]
+
+
+@pytest.fixture(scope="module")
+def results(batch):
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    return {s: _one_step_params(s, mesh, batch) for s in STRATEGIES}
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES[1:])
+def test_strategies_match_allreduce(results, strategy):
+    ref_params, ref_loss = results["allreduce"]
+    got_params, got_loss = results[strategy]
+    assert got_loss == pytest.approx(ref_loss, rel=1e-6)
+    ref_leaves = jax.tree.leaves(ref_params)
+    got_leaves = jax.tree.leaves(got_params)
+    assert len(ref_leaves) == len(got_leaves)
+    for r, g in zip(ref_leaves, got_leaves):
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+
+def test_sync_actually_replicates_params(results):
+    """After one synced step, every replica's params must agree (DDP's
+    broadcast-at-construction + identical-updates invariant)."""
+    params, _ = results["p2p_star"]
+    # Values came back as a single global (replicated) array; a second
+    # step from them must not diverge — run two more steps under star.
+    # (Replication is structurally guaranteed by out_specs=P(); this
+    # checks the star's mean really is the global mean on every replica
+    # by comparing against gather_scatter.)
+    ref, _ = results["gather_scatter"]
+    for r, g in zip(jax.tree.leaves(ref), jax.tree.leaves(params)):
+        np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
+
+
+def test_none_requires_single_device():
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    cfg = TrainConfig(model="tiny_cnn", sync="none", num_devices=4,
+                      global_batch_size=16)
+    with pytest.raises(ValueError):
+        Trainer(cfg, mesh=mesh)
+
+
+def test_unknown_strategy_rejected():
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import get_sync
+
+    with pytest.raises(ValueError):
+        get_sync("nccl")
