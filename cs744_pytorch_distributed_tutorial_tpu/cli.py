@@ -48,6 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--synthetic-train-size", type=int, default=None)
     p.add_argument("--synthetic-test-size", type=int, default=None)
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default=None)
+    p.add_argument("--fused-optimizer", action="store_true", default=None,
+                   help="use the Pallas fused SGD kernel (ops/fused_sgd.py)")
     p.add_argument("--log-every", type=int, default=None)
     p.add_argument("--checkpoint-dir", default=None)
     # init_process mirror (master/part2a/part2a.py:80-85)
@@ -80,6 +82,7 @@ _ARG_TO_FIELD = {
     "synthetic_train_size": "synthetic_train_size",
     "synthetic_test_size": "synthetic_test_size",
     "compute_dtype": "compute_dtype",
+    "fused_optimizer": "fused_optimizer",
     "log_every": "log_every",
     "checkpoint_dir": "checkpoint_dir",
     "coordinator_address": "coordinator_address",

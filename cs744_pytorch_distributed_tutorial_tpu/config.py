@@ -47,6 +47,10 @@ class TrainConfig:
     # Numerics: params/BN stats stay float32; compute dtype is the MXU knob.
     compute_dtype: str = "float32"  # "bfloat16" on real TPU runs
 
+    # Use the Pallas fused SGD kernel (ops/fused_sgd.py) instead of the
+    # optax chain; runs in interpret mode off-TPU.
+    fused_optimizer: bool = False
+
     # Logging / instrumentation (reference prints loss every 20 batches and
     # the avg per-batch time over batches 1-10: master/part1/part1.py:39-44)
     log_every: int = 20

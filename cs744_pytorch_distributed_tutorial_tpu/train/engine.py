@@ -92,7 +92,20 @@ class Trainer:
         self.model = get_model(
             cfg.model, num_classes=cfg.num_classes, dtype=_DTYPES[cfg.compute_dtype]
         )
-        self.tx = make_optimizer(cfg)
+        if cfg.fused_optimizer:
+            from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
+
+            platforms = {d.platform for d in self.mesh.devices.flat}
+            # Mosaic-compile only on TPU backends ('tpu', or this
+            # environment's 'axon' plugin); interpret mode elsewhere.
+            self.tx = FusedSGD(
+                cfg.learning_rate,
+                cfg.momentum,
+                cfg.weight_decay,
+                interpret=platforms.isdisjoint({"tpu", "axon"}),
+            )
+        else:
+            self.tx = make_optimizer(cfg)
         self.log = get_logger()
         self._sync_fn = get_sync(cfg.sync)
         self._check_vma = cfg.sync not in UNCHECKED_REPLICATION
@@ -167,8 +180,11 @@ class Trainer:
                 grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
                 loss = lax.pmean(local_loss, DATA_AXIS)
 
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            if cfg.fused_optimizer:
+                new_params, new_opt = tx.apply(state.params, state.opt_state, grads)
+            else:
+                updates, new_opt = tx.update(grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
             metrics = {
                 "loss": loss,  # global mean for logging
                 "local_loss": local_loss[None],  # [1]/replica -> [axis_size]
