@@ -21,7 +21,7 @@ import numpy as np
 
 # Round-1 measured value on one TPU v5 lite chip (bf16, global batch 1024,
 # sync='auto'). Later rounds benchmark against this.
-ROUND1_BASELINE_SPS = None  # set after first TPU measurement
+ROUND1_BASELINE_SPS = 21_700.0
 
 GLOBAL_BATCH = 1024
 WARMUP_STEPS = 5
@@ -54,14 +54,21 @@ def main() -> None:
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
 
+    # Close each timing region by fetching a concrete scalar (device_get of
+    # the last step's loss): a host round-trip cannot complete before the
+    # dependent computation does. ``block_until_ready`` alone is NOT a
+    # reliable fence on this environment's tunneled TPU backend — it can
+    # return while steps are still in flight, inflating samples/sec ~40x
+    # (measured: 30 steps "completed" in 21 ms by block_until_ready, while
+    # the value fetch took the true 3.98 s).
     for _ in range(WARMUP_STEPS):
         state, metrics = trainer.train_step(state, x, y, key)
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
         state, metrics = trainer.train_step(state, x, y, key)
-    jax.block_until_ready(state.params)
+    float(metrics["loss"])
     elapsed = time.perf_counter() - t0
 
     sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed

@@ -328,13 +328,16 @@ class Trainer:
             timer.start()
             for batch_idx, (x, y) in enumerate(train_loader.epoch(epoch)):
                 state, metrics = self.train_step(state, x, y, base_key)
-                # Block on the loss only while timing or logging needs the
-                # value — otherwise leave dispatch fully async so the host
-                # stages batch N+1 while the device runs batch N.
+                # Fetch the loss value only while timing or logging needs
+                # it — otherwise leave dispatch fully async so the host
+                # stages batch N+1 while the device runs batch N. The fetch
+                # must be a device_get (float()), not block_until_ready:
+                # the latter is not a reliable completion fence on this
+                # environment's tunneled TPU backend (see bench.py).
                 timing_active = timer.steps_recorded <= cfg.timing_batches[1]
                 should_log = batch_idx % cfg.log_every == 0
                 if timing_active or should_log:
-                    loss = jax.block_until_ready(metrics["loss"])
+                    loss = float(metrics["loss"])
                 if timing_active:
                     timer.tick()
                     if timer.steps_recorded == cfg.timing_batches[1] + 1:
@@ -342,9 +345,8 @@ class Trainer:
                         history["avg_batch_time"] = avg
                         self.log.info("average time:  %f", avg)
                 if should_log:
-                    loss_val = float(loss)
-                    history["train_loss"].append((epoch, batch_idx, loss_val))
-                    self.log.info("%d loss:  %f", batch_idx, loss_val)
+                    history["train_loss"].append((epoch, batch_idx, loss))
+                    self.log.info("%d loss:  %f", batch_idx, loss)
                 steps_done += 1
                 if ckpt and cfg.checkpoint_every and steps_done % cfg.checkpoint_every == 0:
                     ckpt.save(state)

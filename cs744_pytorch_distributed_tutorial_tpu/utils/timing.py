@@ -17,8 +17,11 @@ import time
 class StepTimer:
     """Records per-step wall-clock; averages a window excluding step 0.
 
-    Call ``tick()`` after each step has been blocked on
-    (``jax.block_until_ready`` on an output). ``window`` is the inclusive
+    Call ``tick()`` after fetching a concrete value from the step (e.g.
+    ``float(output)``) — a host round-trip is the reliable completion
+    fence; ``jax.block_until_ready`` can return early on this
+    environment's tunneled TPU backend (see ``bench.py``). ``window`` is
+    the inclusive
     (first, last) step range averaged — default (1, 10), the reference's
     batches-1-to-10 window with compile excluded.
     """
