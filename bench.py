@@ -54,26 +54,31 @@ def main() -> None:
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
 
-    # Close each timing region by fetching a concrete scalar (device_get of
-    # the last step's loss): a host round-trip cannot complete before the
-    # dependent computation does. ``block_until_ready`` alone is NOT a
-    # reliable fence on this environment's tunneled TPU backend — it can
-    # return while steps are still in flight, inflating samples/sec ~40x
-    # (measured: 30 steps "completed" in 21 ms by block_until_ready, while
-    # the value fetch took the true 3.98 s).
+    # Close each timing region by fetching a concrete scalar derived from
+    # the LAST step's params: a host round-trip cannot complete before the
+    # dependent computation — including that step's gradient sync and
+    # optimizer update — does. ``block_until_ready`` alone is NOT a
+    # reliable completion fence on this environment's tunneled TPU backend
+    # (measured: it returned after 21 ms for 30 steps that the value fetch
+    # showed actually took 3.98 s, a ~190x inflation). Fetching only the
+    # loss would be weaker: step N's loss depends on step N-1's params, so
+    # it leaves step N's own update unfenced.
+    def fence(s) -> None:
+        float(jax.tree.leaves(s.params)[0].ravel()[0])
+
     for _ in range(WARMUP_STEPS):
         state, metrics = trainer.train_step(state, x, y, key)
-    float(metrics["loss"])
+    fence(state)
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
         state, metrics = trainer.train_step(state, x, y, key)
-    float(metrics["loss"])
+    fence(state)
     elapsed = time.perf_counter() - t0
 
     sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed
     sps_per_chip = sps / n_chips
-    vs = 1.0 if ROUND1_BASELINE_SPS is None else sps_per_chip / ROUND1_BASELINE_SPS
+    vs = sps_per_chip / ROUND1_BASELINE_SPS
     print(
         json.dumps(
             {

@@ -1,0 +1,34 @@
+"""Data pipeline: CIFAR-10 source, sharded sampling, global-batch loading,
+on-device augmentation — the torchvision + DataLoader + DistributedSampler
+stack (``master/part1/part1.py:66-93``, ``master/part2a/part2a.py:103-113``)
+rebuilt TPU-first (host ships uint8; transforms trace into the jitted step)."""
+
+from cs744_pytorch_distributed_tutorial_tpu.data.augment import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    augment_train_batch,
+    eval_batch,
+    normalize,
+    random_crop_flip,
+)
+from cs744_pytorch_distributed_tutorial_tpu.data.cifar10 import (
+    CIFAR10Dataset,
+    load_cifar10,
+    synthetic_cifar10,
+)
+from cs744_pytorch_distributed_tutorial_tpu.data.loader import BatchLoader
+from cs744_pytorch_distributed_tutorial_tpu.data.sampler import ShardedSampler
+
+__all__ = [
+    "CIFAR10_MEAN",
+    "CIFAR10_STD",
+    "CIFAR10Dataset",
+    "BatchLoader",
+    "ShardedSampler",
+    "augment_train_batch",
+    "eval_batch",
+    "normalize",
+    "random_crop_flip",
+    "load_cifar10",
+    "synthetic_cifar10",
+]

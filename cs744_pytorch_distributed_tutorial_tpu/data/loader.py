@@ -1,0 +1,130 @@
+"""Batch loading: global batches as data-sharded ``jax.Array``s.
+
+Replaces the reference's ``DataLoader(num_workers=2, pin_memory=True)`` +
+``DistributedSampler`` pair (``master/part1/part1.py:80-93``,
+``master/part2a/part2a.py:103-113``). Where torch runs worker processes
+per rank yielding rank-local tensors, here each epoch is a deterministic
+index plan (``sampler.epoch_permutation`` + ``wrap_pad`` — the same
+primitives ``ShardedSampler`` is built from) and every batch is ONE global
+``jax.Array`` laid out along the mesh's data axis — single-host via
+``device_put`` with a ``NamedSharding``, multi-host via
+``jax.make_array_from_process_local_data`` where each process contributes
+only the shard it will feed its local devices.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.data.sampler import (
+    epoch_permutation,
+    wrap_pad,
+)
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    DATA_AXIS,
+    local_to_global_batch,
+    shard_global_batch,
+)
+
+
+class BatchLoader:
+    """Deterministic sharded batch iterator over in-memory arrays.
+
+    ``epoch(e)`` yields ``(images, labels)`` global arrays of exactly
+    ``global_batch_size``, wrap-around padding the final batch (the
+    DistributedSampler contract) unless ``drop_last``. Shapes are static
+    across all batches — one XLA compilation per run.
+
+    ``epoch_padded(e)`` yields ``(images, labels, mask)`` where the tail
+    batch is zero-padded and ``mask`` is 1.0 on real examples — so eval
+    counts every example exactly once on any mesh, which is the working
+    version of the reference's broken eval aggregation (the slave's
+    ``isend`` of its ``correct`` count that the master never receives,
+    ``slave/part2b/part2b.py:67-69``, SURVEY §2.1 #6).
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        global_batch_size: int,
+        *,
+        mesh: jax.sharding.Mesh,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        axis: str = DATA_AXIS,
+    ):
+        if len(images) != len(labels):
+            raise ValueError(
+                f"images/labels length mismatch: {len(images)} vs {len(labels)}"
+            )
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels, dtype=np.int32)
+        self.global_batch_size = int(global_batch_size)
+        self.mesh = mesh
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.axis = axis
+        self.num_examples = len(images)
+        if self.num_examples == 0:
+            raise ValueError("empty dataset")
+        if drop_last and self.num_examples < self.global_batch_size:
+            raise ValueError(
+                f"dataset of {self.num_examples} examples yields ZERO batches of "
+                f"{self.global_batch_size} with drop_last=True; shrink the batch "
+                "or pass drop_last=False (wrap-around pad)"
+            )
+
+    def __len__(self) -> int:
+        """Batches per epoch."""
+        if self.drop_last:
+            return max(self.num_examples // self.global_batch_size, 0)
+        return -(-self.num_examples // self.global_batch_size)  # ceil
+
+    # ------------------------------------------------------------------ place
+    def _put_global(self, *arrays: np.ndarray):
+        """Place per-example host arrays (identical on every process) as
+        global data-sharded jax.Arrays — all with the same slice math, so
+        data/labels/mask can never land on mismatched layouts."""
+        if jax.process_count() == 1:
+            return shard_global_batch(self.mesh, *arrays, axis=self.axis)
+        # Multi-host: each process materializes only its contiguous slice
+        # of the global batch; consistent because every process computed
+        # the identical (seed, epoch)-deterministic plan.
+        n, p, i = len(arrays[0]), jax.process_count(), jax.process_index()
+        lo, hi = n * i // p, n * (i + 1) // p
+        return local_to_global_batch(
+            self.mesh, *(a[lo:hi] for a in arrays), axis=self.axis
+        )
+
+    # ------------------------------------------------------------------ epochs
+    def epoch(self, epoch: int) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Full-size training batches (wrap-padded unless ``drop_last``)."""
+        order = epoch_permutation(self.num_examples, self.seed, epoch, self.shuffle)
+        bsz = self.global_batch_size
+        order = wrap_pad(order, len(self) * bsz)
+        for b in range(len(self)):
+            idx = order[b * bsz : (b + 1) * bsz]
+            yield self._put_global(self.images[idx], self.labels[idx])
+
+    def epoch_padded(
+        self, epoch: int
+    ) -> Iterator[tuple[jax.Array, jax.Array, jax.Array]]:
+        """Eval batches with a validity mask; every example appears exactly
+        once, shapes stay static (pad entries replay index 0, mask 0.0)."""
+        order = epoch_permutation(self.num_examples, self.seed, epoch, self.shuffle)
+        bsz = self.global_batch_size
+        n_batches = -(-self.num_examples // bsz)  # ceil: never drop for eval
+        for b in range(n_batches):
+            idx = order[b * bsz : (b + 1) * bsz]
+            n_real = len(idx)
+            mask = np.zeros(bsz, dtype=np.float32)
+            mask[:n_real] = 1.0
+            if n_real < bsz:
+                idx = np.concatenate([idx, np.zeros(bsz - n_real, dtype=idx.dtype)])
+            yield self._put_global(self.images[idx], self.labels[idx], mask)

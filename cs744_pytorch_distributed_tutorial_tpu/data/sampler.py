@@ -1,0 +1,81 @@
+"""Sharded sampling: the ``DistributedSampler`` contract, reimplemented.
+
+The reference shards the training set per rank with
+``torch.utils.data.DistributedSampler`` (``master/part2a/part2a.py:107``):
+a (seed, epoch)-deterministic global permutation, wrap-around padding to a
+multiple of the world size, then a strided rank split. Same contract here,
+generalized to any shard count (the reference hardcodes world size 4
+everywhere — SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def epoch_permutation(
+    num_examples: int, seed: int, epoch: int, shuffle: bool
+) -> np.ndarray:
+    """(seed, epoch)-deterministic example order — every process computes
+    the identical plan with no communication (the DistributedSampler
+    ``set_epoch`` discipline, ``master/part2a/part2a.py:89-90,107``)."""
+    if shuffle:
+        return np.random.default_rng((seed, epoch)).permutation(num_examples)
+    return np.arange(num_examples)
+
+
+def wrap_pad(order: np.ndarray, total: int) -> np.ndarray:
+    """Truncate or cyclically repeat ``order`` to exactly ``total`` entries
+    (DistributedSampler's wrap-around padding, repeating as many times as
+    needed when ``total`` exceeds the dataset size)."""
+    if total <= len(order):
+        return order[:total]
+    return np.resize(order, total)
+
+
+class ShardedSampler:
+    """Deterministic equal-size sharding of ``range(num_examples)``.
+
+    Guarantees (the DistributedSampler contract):
+    - every shard has the same length: ``ceil(n / num_shards)`` with
+      wrap-around padding, or ``floor(n / num_shards)`` with ``drop_last``;
+    - the union of all shards covers the dataset (padding duplicates at
+      most ``num_shards - 1`` examples);
+    - ``indices(epoch)`` is a pure function of
+      ``(seed, epoch, shard, num_shards)`` — every process computes its own
+      shard with no communication;
+    - ``shuffle=False`` gives the plain strided split
+      ``[shard, shard + num_shards, ...]``.
+    """
+
+    def __init__(
+        self,
+        num_examples: int,
+        num_shards: int,
+        shard: int,
+        *,
+        seed: int = 0,
+        shuffle: bool = True,
+        drop_last: bool = False,
+    ):
+        if not 0 <= shard < num_shards:
+            raise ValueError(f"shard {shard} out of range for {num_shards} shards")
+        self.num_examples = num_examples
+        self.num_shards = num_shards
+        self.shard = shard
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if drop_last:
+            self._per_shard = num_examples // num_shards
+        else:
+            self._per_shard = -(-num_examples // num_shards)  # ceil
+
+    def __len__(self) -> int:
+        return self._per_shard
+
+    def indices(self, epoch: int) -> np.ndarray:
+        """This shard's example indices for ``epoch``."""
+        order = epoch_permutation(self.num_examples, self.seed, epoch, self.shuffle)
+        order = wrap_pad(order, self._per_shard * self.num_shards)
+        return order[self.shard :: self.num_shards]
