@@ -60,9 +60,11 @@ def main() -> None:
     # optimizer update — does. ``block_until_ready`` alone is NOT a
     # reliable completion fence on this environment's tunneled TPU backend
     # (measured: it returned after 21 ms for 30 steps that the value fetch
-    # showed actually took 3.98 s, a ~190x inflation). Fetching only the
-    # loss would be weaker: step N's loss depends on step N-1's params, so
-    # it leaves step N's own update unfenced.
+    # showed actually took 3.98 s, a ~190x inflation). The in-graph
+    # multi-step path (``Trainer.train_steps``) is benchmarked on CPU
+    # meshes only for now: on this tunneled single-chip backend the
+    # scanned program wedges the tunnel (observed twice), so the scored
+    # number stays on the per-step dispatch path.
     def fence(s) -> None:
         float(jax.tree.leaves(s.params)[0].ravel()[0])
 

@@ -12,6 +12,22 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+# compute_dtype values accepted by both trainers (engine.py, lm.py).
+# Resolved lazily so importing config stays jax-free.
+COMPUTE_DTYPES = ("float32", "bfloat16")
+
+
+def resolve_dtype(name: str):
+    import jax.numpy as jnp
+
+    table = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+    try:
+        return table[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown compute_dtype {name!r}; choose from {COMPUTE_DTYPES}"
+        ) from None
+
 
 @dataclasses.dataclass
 class TrainConfig:

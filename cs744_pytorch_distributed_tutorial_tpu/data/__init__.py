@@ -18,6 +18,7 @@ from cs744_pytorch_distributed_tutorial_tpu.data.cifar10 import (
 )
 from cs744_pytorch_distributed_tutorial_tpu.data.loader import BatchLoader
 from cs744_pytorch_distributed_tutorial_tpu.data.sampler import ShardedSampler
+from cs744_pytorch_distributed_tutorial_tpu.data.text import synthetic_tokens
 
 __all__ = [
     "CIFAR10_MEAN",
@@ -31,4 +32,5 @@ __all__ = [
     "random_crop_flip",
     "load_cifar10",
     "synthetic_cifar10",
+    "synthetic_tokens",
 ]

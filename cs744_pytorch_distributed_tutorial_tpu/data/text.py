@@ -1,0 +1,38 @@
+"""Synthetic token streams for the LM/long-context path.
+
+Counterpart of ``cifar10.synthetic_cifar10`` for the transformer family:
+deterministic, learnable structure (each sequence follows a per-class
+cyclic token pattern with noise), so LM tests can assert loss decrease
+without a real corpus in this no-egress environment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def synthetic_tokens(
+    num_seqs: int,
+    seq_len: int,
+    vocab_size: int,
+    *,
+    seed: int = 0,
+    noise: float = 0.05,
+) -> np.ndarray:
+    """[num_seqs, seq_len + 1] int32 tokens (callers split input/target).
+
+    Each sequence walks the vocab with a fixed per-sequence stride, so the
+    next token is a deterministic function of the current one — a pattern
+    a causal LM learns within a few steps — with ``noise`` fraction of
+    positions replaced by uniform random tokens.
+    """
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, vocab_size, size=num_seqs)
+    strides = rng.integers(1, max(vocab_size // 4, 2), size=num_seqs)
+    pos = np.arange(seq_len + 1)
+    tokens = (starts[:, None] + strides[:, None] * pos[None, :]) % vocab_size
+    corrupt = rng.random(tokens.shape) < noise
+    tokens = np.where(
+        corrupt, rng.integers(0, vocab_size, size=tokens.shape), tokens
+    )
+    return tokens.astype(np.int32)

@@ -20,6 +20,10 @@ from cs744_pytorch_distributed_tutorial_tpu.models.resnet import (
     resnet34,
     resnet50,
 )
+from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+    TransformerLM,
+    transformer_lm,
+)
 from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
     VGG,
     VGG_CFGS,
@@ -65,6 +69,10 @@ MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {
     "resnet50": resnet50,
     "tiny_cnn": tiny_cnn,
 }
+# TransformerLM is deliberately NOT in MODEL_REGISTRY: the registry's
+# contract is image classifiers constructed as f(num_classes=, dtype=)
+# by the CIFAR Trainer; the LM family is driven by train/lm.py's
+# LMTrainer instead.
 
 
 def get_model(name: str, **kw: Any) -> nn.Module:
@@ -82,6 +90,8 @@ __all__ = [
     "get_model",
     "ResNet",
     "TinyCNN",
+    "TransformerLM",
+    "transformer_lm",
     "VGG",
     "VGG_CFGS",
     "resnet18",

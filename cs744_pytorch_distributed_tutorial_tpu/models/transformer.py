@@ -1,0 +1,163 @@
+"""Decoder-only transformer LM with pluggable sequence parallelism.
+
+No counterpart exists in the reference (its only model is conv VGG-11,
+``master/part1/model.py:30-46``) — this is the long-context model family
+that exercises the framework's sequence/context parallelism
+(``parallel/ring_attention.py``) as a first-class capability, the same
+way VGG exercises data parallelism.
+
+Design for SPMD: the module is agnostic to whether it runs on a full or a
+sequence-sharded block. When ``seq_axis`` is set, the module is being
+traced inside ``shard_map`` with activations of shape
+``[B_local, T_local, ...]``; attention routes through the ring or
+all-to-all variant over that axis and position embeddings use the
+device's global offset (``lax.axis_index * T_local``). With
+``seq_axis=None`` the same code is plain single-device attention — which
+also makes host-side ``init`` trivial (attention has no parameters, so
+the param tree is identical either way).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+ATTENTION_IMPLS = ("dense", "ring", "ulysses")
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention; the comm pattern is a config knob."""
+
+    num_heads: int
+    dtype: Any = jnp.float32
+    impl: str = "dense"
+    seq_axis: str | None = None
+    seq_axis_size: int = 1
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, d_model = x.shape
+        if d_model % self.num_heads:
+            raise ValueError(
+                f"d_model {d_model} not divisible by num_heads {self.num_heads}"
+            )
+        head_dim = d_model // self.num_heads
+        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, self.num_heads, head_dim)
+        q, k, v = (a.reshape(shape) for a in (q, k, v))
+
+        if self.seq_axis is None or self.seq_axis_size == 1:
+            out = dense_attention(q, k, v, causal=self.causal)
+        elif self.impl == "ring":
+            out = ring_attention(
+                q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
+            )
+        elif self.impl == "ulysses":
+            out = ulysses_attention(
+                q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
+            )
+        elif self.impl == "dense":
+            raise ValueError(
+                "impl='dense' cannot run on a sequence-sharded axis (no "
+                "communication to see the full sequence); use 'ring' or "
+                "'ulysses', or set seq_axis=None"
+            )
+        else:
+            raise ValueError(
+                f"unknown attention impl {self.impl!r}; choose from {ATTENTION_IMPLS}"
+            )
+        out = out.reshape(b, t, d_model).astype(self.dtype)
+        return nn.Dense(d_model, use_bias=False, dtype=self.dtype)(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    d_ff: int
+    dtype: Any = jnp.float32
+    impl: str = "dense"
+    seq_axis: str | None = None
+    seq_axis_size: int = 1
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + Attention(
+            num_heads=self.num_heads,
+            dtype=self.dtype,
+            impl=self.impl,
+            seq_axis=self.seq_axis,
+            seq_axis_size=self.seq_axis_size,
+            causal=self.causal,
+        )(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal LM over token ids.
+
+    ``__call__(tokens [B, T_local]) -> logits [B, T_local, vocab]``
+    (float32 logits for a full-precision softmax, as elsewhere in the
+    model zoo). Works both as a plain model and inside ``shard_map`` with
+    the sequence dimension sharded (set ``seq_axis``/``seq_axis_size``).
+    """
+
+    vocab_size: int = 1024
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 256
+    d_ff: int = 1024
+    max_seq_len: int = 2048
+    dtype: Any = jnp.float32
+    attention_impl: str = "ring"
+    seq_axis: str | None = None
+    seq_axis_size: int = 1
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+        b, t_local = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        # Global positions: a sequence-sharded block starts at the
+        # device's offset along the seq axis, not at 0.
+        offset = (
+            lax.axis_index(self.seq_axis) * t_local
+            if self.seq_axis is not None and self.seq_axis_size > 1
+            else 0
+        )
+        positions = offset + jnp.arange(t_local)
+        x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
+            positions
+        )
+        for _ in range(self.num_layers):
+            x = Block(
+                num_heads=self.num_heads,
+                d_ff=self.d_ff,
+                dtype=self.dtype,
+                impl=self.attention_impl,
+                seq_axis=self.seq_axis,
+                seq_axis_size=self.seq_axis_size,
+                causal=self.causal,
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype)(x)
+        return logits.astype(jnp.float32)
+
+
+def transformer_lm(**kw: Any) -> TransformerLM:
+    return TransformerLM(**kw)

@@ -136,6 +136,17 @@ def shard_global_batch(mesh: Mesh, *arrays: jax.Array | np.ndarray, axis: str = 
     return out[0] if len(out) == 1 else out
 
 
+def shard_stacked_batches(
+    mesh: Mesh, *arrays: jax.Array | np.ndarray, axis: str = DATA_AXIS
+):
+    """Place ``[num_steps, global_batch, ...]`` host arrays with the batch
+    (second) dim sharded along the data axis — the layout
+    ``Trainer.train_steps`` scans over (leading dim = scan steps)."""
+    sharding = NamedSharding(mesh, P(None, axis))
+    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    return out[0] if len(out) == 1 else out
+
+
 def local_to_global_batch(mesh: Mesh, *arrays: np.ndarray, axis: str = DATA_AXIS):
     """Assemble a global sharded array from per-process local shards.
 

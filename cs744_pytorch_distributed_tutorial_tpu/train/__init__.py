@@ -2,5 +2,6 @@
 
 from cs744_pytorch_distributed_tutorial_tpu.train.state import TrainState, make_optimizer
 from cs744_pytorch_distributed_tutorial_tpu.train.engine import Trainer
+from cs744_pytorch_distributed_tutorial_tpu.train.lm import LMConfig, LMTrainer, SEQ_AXIS
 
-__all__ = ["TrainState", "make_optimizer", "Trainer"]
+__all__ = ["TrainState", "make_optimizer", "Trainer", "LMConfig", "LMTrainer", "SEQ_AXIS"]

@@ -66,7 +66,7 @@ from cs744_pytorch_distributed_tutorial_tpu.train.state import (
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
 from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
 
-_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+from cs744_pytorch_distributed_tutorial_tpu.config import resolve_dtype
 
 
 class Trainer:
@@ -90,7 +90,7 @@ class Trainer:
                 f"data-axis size {self.axis_size}"
             )
         self.model = get_model(
-            cfg.model, num_classes=cfg.num_classes, dtype=_DTYPES[cfg.compute_dtype]
+            cfg.model, num_classes=cfg.num_classes, dtype=resolve_dtype(cfg.compute_dtype)
         )
         if cfg.fused_optimizer:
             from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
@@ -208,6 +208,34 @@ class Trainer:
             check_vma=self._check_vma,
         )
         self.train_step = jax.jit(mapped_train, donate_argnums=0)
+
+        def local_train_scan(state: TrainState, images, labels, base_key):
+            """Many steps in ONE traced program: ``lax.scan`` over a
+            leading ``[num_steps, ...]`` axis of device-resident batches.
+
+            The reference's epoch loop crosses host<->device (and, in
+            parts 2-3, the network stack) every batch
+            (``master/part1/part1.py:31-38``); here the whole span is a
+            single XLA computation — zero per-step dispatch, and the
+            latency-hiding scheduler pipelines step N's collectives with
+            step N+1's compute across iterations. Per-step randomness
+            still advances: ``local_train_step`` folds the key with
+            ``state.step``, which increments inside the scan body."""
+
+            def body(st, xy):
+                return local_train_step(st, xy[0], xy[1], base_key)
+
+            return lax.scan(body, state, (images, labels))
+
+        scan_metric_specs = {"loss": P(), "local_loss": P(None, DATA_AXIS)}
+        mapped_scan = jax.shard_map(
+            local_train_scan,
+            mesh=self.mesh,
+            in_specs=(state_specs, P(None, DATA_AXIS), P(None, DATA_AXIS), P()),
+            out_specs=(state_specs, scan_metric_specs),
+            check_vma=self._check_vma,
+        )
+        self.train_steps = jax.jit(mapped_scan, donate_argnums=0)
 
         def local_eval_step(state: TrainState, images, labels, mask):
             """Eval on the local shard with the replica's own running BN

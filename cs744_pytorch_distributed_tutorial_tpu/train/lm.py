@@ -1,0 +1,219 @@
+"""LM training engine: data x sequence parallelism on one 2-D mesh.
+
+The CIFAR engine (``train/engine.py``) reproduces the reference's
+data-parallel pedagogy; this engine is the long-context counterpart the
+reference never reaches: batch sharded along ``data``, sequence sharded
+along ``seq``, attention communicating over the ``seq`` axis (ring
+ppermute hops or Ulysses all-to-all — ``parallel/ring_attention.py``),
+gradients synced the part3/DDP way (differentiate the axis-meaned loss;
+the autodiff transpose inserts the psum over BOTH mesh axes, since params
+are replicated across the full mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cs744_pytorch_distributed_tutorial_tpu.config import resolve_dtype
+from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+    TransformerLM,
+)
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+)
+
+SEQ_AXIS = "seq"
+
+
+@dataclasses.dataclass
+class LMConfig:
+    """Long-context training run: model dims + 2-D mesh layout."""
+
+    vocab_size: int = 1024
+    num_layers: int = 2
+    num_heads: int = 8
+    d_model: int = 128
+    d_ff: int = 512
+    max_seq_len: int = 2048
+    attention_impl: str = "ring"  # ring | ulysses | dense
+    compute_dtype: str = "float32"  # "bfloat16" on real TPU runs
+
+    data_parallel: int = 1
+    seq_parallel: int = 1
+
+    global_batch_size: int = 8
+    seq_len: int = 256  # tokens per sequence fed to the model
+    learning_rate: float = 1e-3
+    seed: int = 0
+
+    def replace(self, **kw: Any) -> "LMConfig":
+        return dataclasses.replace(self, **kw)
+
+
+class LMTrainer:
+    """Jitted shard_map train/eval steps for ``TransformerLM`` on a
+    ``{"data": d, "seq": s}`` mesh."""
+
+    def __init__(self, cfg: LMConfig, mesh=None):
+        self.cfg = cfg
+        if mesh is None:
+            mesh = make_mesh(
+                {DATA_AXIS: cfg.data_parallel, SEQ_AXIS: cfg.seq_parallel}
+            )
+        self.mesh = mesh
+        self.data_size = mesh.shape[DATA_AXIS]
+        self.seq_size = mesh.shape[SEQ_AXIS]
+        if cfg.global_batch_size % self.data_size:
+            raise ValueError(
+                f"global batch {cfg.global_batch_size} not divisible by "
+                f"data axis {self.data_size}"
+            )
+        if cfg.seq_len % self.seq_size:
+            raise ValueError(
+                f"seq_len {cfg.seq_len} not divisible by seq axis {self.seq_size}"
+            )
+        if cfg.seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"seq_len {cfg.seq_len} exceeds max_seq_len {cfg.max_seq_len}: "
+                "position indices would gather out of bounds (NaN on CPU, "
+                "silently clamped/wrong positions on TPU)"
+            )
+        if cfg.attention_impl == "dense" and self.seq_size > 1:
+            raise ValueError(
+                "attention_impl='dense' is incompatible with seq_parallel > 1 "
+                "(a sequence-sharded block cannot attend to the full sequence "
+                "without communication); use 'ring' or 'ulysses'"
+            )
+        dtype = resolve_dtype(cfg.compute_dtype)
+        self.model = TransformerLM(
+            vocab_size=cfg.vocab_size,
+            num_layers=cfg.num_layers,
+            num_heads=cfg.num_heads,
+            d_model=cfg.d_model,
+            d_ff=cfg.d_ff,
+            max_seq_len=cfg.max_seq_len,
+            dtype=dtype,
+            attention_impl=cfg.attention_impl,
+            seq_axis=SEQ_AXIS,
+            seq_axis_size=self.seq_size,
+        )
+        self.tx = optax.adamw(cfg.learning_rate)
+        self._build_steps()
+
+    # ------------------------------------------------------------------ build
+    def _build_steps(self) -> None:
+        model, tx = self.model, self.tx
+        batch_spec = P(DATA_AXIS, SEQ_AXIS)  # [batch, seq] token grids
+
+        def local_step(params, opt_state, tokens, targets):
+            def loss_fn(p):
+                logits = model.apply({"params": p}, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                ).mean()
+
+            # Differentiate the LOCAL loss, then average grads explicitly
+            # over both mesh axes. Under ``check_vma=False`` (which the
+            # axis-index-routed attention collectives require) shard_map
+            # disables the replication analysis that would let the AD
+            # transpose insert the psum automatically — the engine's
+            # 'auto' trick (train/engine.py) — so relying on it here
+            # silently yields per-device partial grads and divergent
+            # replicas. Autodiff through the ring/all-to-all collectives
+            # is joint (ppermute transposes to the reverse ring), so each
+            # device's grad already carries the cross-shard attention
+            # terms; the pmean supplies the final cross-device sum. Equal
+            # token counts per shard make pmean of local means the exact
+            # global mean.
+            local_loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = jax.tree.map(
+                lambda g: lax.pmean(lax.pmean(g, DATA_AXIS), SEQ_AXIS), grads
+            )
+            loss = lax.pmean(lax.pmean(local_loss, DATA_AXIS), SEQ_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss}
+
+        self.train_step = jax.jit(
+            jax.shard_map(
+                local_step,
+                mesh=self.mesh,
+                in_specs=(P(), P(), batch_spec, batch_spec),
+                out_specs=(P(), P(), {"loss": P()}),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        def local_eval(params, tokens, targets):
+            logits = model.apply({"params": params}, tokens)
+            local = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            return {"loss": lax.pmean(lax.pmean(local, DATA_AXIS), SEQ_AXIS)}
+
+        self.eval_step = jax.jit(
+            jax.shard_map(
+                local_eval,
+                mesh=self.mesh,
+                in_specs=(P(), batch_spec, batch_spec),
+                out_specs={"loss": P()},
+                check_vma=False,
+            )
+        )
+
+    # ------------------------------------------------------------------ state
+    def init(self, seed: int | None = None):
+        """Host-side init: attention carries no parameters, so a
+        ``seq_axis=None`` clone yields the identical param tree without
+        needing mesh axes in scope."""
+        cfg = self.cfg
+        init_model = self.model.clone(seq_axis=None, seq_axis_size=1)
+        local_t = cfg.seq_len // self.seq_size
+        dummy = jnp.zeros(
+            (cfg.global_batch_size // self.data_size, local_t), jnp.int32
+        )
+        variables = init_model.init(
+            jax.random.key(cfg.seed if seed is None else seed), dummy
+        )
+        params = variables["params"]
+        opt_state = self.tx.init(params)
+        rep = NamedSharding(self.mesh, P())
+        return jax.device_put(params, rep), jax.device_put(opt_state, rep)
+
+    def shard_batch(self, tokens):
+        """[B, seq_len + 1] host tokens -> (inputs, targets) global arrays
+        sharded [data, seq]. The shifted targets are materialized BEFORE
+        sharding, so each sequence shard's last position still has its
+        true next token as the label (no cross-shard halo needed)."""
+        inputs = tokens[:, :-1]
+        targets = tokens[:, 1:]
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS))
+        return (
+            jax.device_put(inputs, sharding),
+            jax.device_put(targets, sharding),
+        )
+
+    # ------------------------------------------------------------------ loop
+    def fit(self, tokens, steps: int) -> tuple[Any, Any, list[float]]:
+        """Minimal loop: cycle batches of ``global_batch_size`` sequences
+        from ``tokens`` [N, seq_len + 1] for ``steps`` steps."""
+        cfg = self.cfg
+        params, opt_state = self.init()
+        losses: list[float] = []
+        n = len(tokens)
+        b = cfg.global_batch_size
+        for step in range(steps):
+            lo = (step * b) % max(n - b + 1, 1)
+            x, y = self.shard_batch(tokens[lo : lo + b])
+            params, opt_state, m = self.train_step(params, opt_state, x, y)
+            losses.append(float(m["loss"]))
+        return params, opt_state, losses

@@ -1,0 +1,106 @@
+"""Long-context path: TransformerLM + LMTrainer on a 2-D (data x seq) mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+
+SMALL = dict(
+    vocab_size=64, num_layers=2, num_heads=4, d_model=64, d_ff=128,
+    max_seq_len=256, global_batch_size=8, seq_len=64, learning_rate=1e-2,
+)
+
+
+def test_transformer_forward_shape():
+    model = TransformerLM(**{k: SMALL[k] for k in
+                             ("vocab_size", "num_layers", "num_heads",
+                              "d_model", "d_ff", "max_seq_len")},
+                          seq_axis=None)
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    logits = model.apply(variables, tokens)
+    assert logits.shape == (2, 32, SMALL["vocab_size"])
+    assert logits.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_lm_training_learns_seq_parallel(impl):
+    """data=2 x seq=4 mesh; loss on the cyclic synthetic stream must drop
+    well below the uniform baseline log(vocab)."""
+    mesh = make_mesh({"data": 2, "seq": 4})
+    cfg = LMConfig(**SMALL, attention_impl=impl, data_parallel=2, seq_parallel=4)
+    tr = LMTrainer(cfg, mesh=mesh)
+    tokens = synthetic_tokens(64, cfg.seq_len, cfg.vocab_size, seed=3)
+    _, _, losses = tr.fit(tokens, steps=80)
+    uniform = np.log(cfg.vocab_size)
+    assert losses[0] == pytest.approx(uniform, rel=0.25)  # starts near chance
+    assert losses[-1] < 0.6 * uniform  # learned the cyclic structure
+    assert np.isfinite(losses).all()
+
+
+def test_seq_parallel_matches_single_device():
+    """The sequence-parallel step must compute the same loss as the same
+    model on an unsharded sequence (ring attention + offset position
+    embeddings are semantically invisible)."""
+    tokens = synthetic_tokens(8, 64, 64, seed=5)
+    cfg1 = LMConfig(**SMALL, attention_impl="dense",
+                    data_parallel=1, seq_parallel=1)
+    mesh1 = make_mesh({"data": 1, "seq": 1}, devices=jax.devices()[:1])
+    tr1 = LMTrainer(cfg1, mesh=mesh1)
+    p1, o1 = tr1.init()
+    x1, y1 = tr1.shard_batch(tokens[:4])
+    m1 = tr1.eval_step(p1, x1, y1)
+
+    cfg8 = LMConfig(**SMALL, attention_impl="ring",
+                    data_parallel=2, seq_parallel=4)
+    mesh8 = make_mesh({"data": 2, "seq": 4})
+    tr8 = LMTrainer(cfg8, mesh=mesh8)
+    p8, o8 = tr8.init()
+    x8, y8 = tr8.shard_batch(tokens[:4])
+    m8 = tr8.eval_step(p8, x8, y8)
+
+    np.testing.assert_allclose(
+        float(m8["loss"]), float(m1["loss"]), rtol=1e-5
+    )
+
+
+def test_lm_params_replicated_after_step():
+    mesh = make_mesh({"data": 4, "seq": 2})
+    cfg = LMConfig(**SMALL, attention_impl="ring",
+                   data_parallel=4, seq_parallel=2)
+    tr = LMTrainer(cfg, mesh=mesh)
+    params, opt_state = tr.init()
+    tokens = synthetic_tokens(8, cfg.seq_len, cfg.vocab_size, seed=7)
+    x, y = tr.shard_batch(tokens[:4])
+    params, opt_state, _ = tr.train_step(params, opt_state, x, y)
+    leaf = jax.tree.leaves(params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_allclose(s, shards[0], rtol=1e-6)
+
+
+def test_seq_len_divisibility_validated():
+    with pytest.raises(ValueError, match="not divisible"):
+        LMTrainer(LMConfig(**{**SMALL, "seq_len": 30},
+                           data_parallel=2, seq_parallel=4),
+                  mesh=make_mesh({"data": 2, "seq": 4}))
+
+
+def test_seq_len_beyond_position_table_rejected():
+    with pytest.raises(ValueError, match="max_seq_len"):
+        LMTrainer(LMConfig(**{**SMALL, "seq_len": 512},  # max_seq_len=256
+                           data_parallel=2, seq_parallel=4),
+                  mesh=make_mesh({"data": 2, "seq": 4}))
+
+
+def test_dense_attention_with_seq_parallel_rejected():
+    with pytest.raises(ValueError, match="incompatible"):
+        LMTrainer(LMConfig(**SMALL, attention_impl="dense",
+                           data_parallel=2, seq_parallel=4),
+                  mesh=make_mesh({"data": 2, "seq": 4}))

@@ -83,6 +83,42 @@ def test_batch_stats_stay_per_replica(dataset):
     )
 
 
+def test_train_steps_scan_matches_loop(dataset):
+    """The in-graph multi-step path (lax.scan) must be numerically
+    equivalent to dispatching the same batches step by step."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        replicated,
+        shard_global_batch,
+        shard_stacked_batches,
+    )
+
+    mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+    cfg = TrainConfig(model="tiny_cnn", sync="allreduce", num_devices=4,
+                      global_batch_size=32, synthetic_data=True)
+    tr = Trainer(cfg, mesh=mesh)
+    n_steps, bsz = 3, 32
+    xs = dataset.train_images[: n_steps * bsz].reshape(n_steps, bsz, 32, 32, 3)
+    ys = dataset.train_labels[: n_steps * bsz].reshape(n_steps, bsz)
+    key = jax.device_put(jax.random.key(9), replicated(mesh))
+
+    s_loop = tr.init()
+    for i in range(n_steps):
+        x, y = shard_global_batch(mesh, xs[i], ys[i])
+        s_loop, m_last = tr.train_step(s_loop, x, y, key)
+
+    s_scan = tr.init()
+    xst, yst = shard_stacked_batches(mesh, xs, ys)
+    s_scan, ms = tr.train_steps(s_scan, xst, yst, key)
+
+    assert ms["loss"].shape == (n_steps,)
+    np.testing.assert_allclose(
+        float(ms["loss"][-1]), float(m_last["loss"]), rtol=1e-5
+    )
+    assert int(jax.device_get(s_scan.step)) == n_steps
+    for a, b in zip(jax.tree.leaves(s_loop.params), jax.tree.leaves(s_scan.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_params_replicated_after_training(dataset):
     mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
     cfg = TrainConfig(
