@@ -67,6 +67,16 @@ class TrainConfig:
     # optax chain; runs in interpret mode off-TPU.
     fused_optimizer: bool = False
 
+    # Input-pipeline prefetch depth: batches staged ahead by a background
+    # thread (the DataLoader num_workers/pin_memory analog,
+    # master/part1/part1.py:80-93). 0 disables.
+    prefetch_depth: int = 2
+
+    # Debug mode: stream per-replica gradient checksums to the host each
+    # step and flag replica divergence (utils/debug.py — the race-detection
+    # analog, SURVEY §5.2). Adds one scalar transfer per replica per step.
+    debug_sync_check: bool = False
+
     # Logging / instrumentation (reference prints loss every 20 batches and
     # the avg per-batch time over batches 1-10: master/part1/part1.py:39-44)
     log_every: int = 20

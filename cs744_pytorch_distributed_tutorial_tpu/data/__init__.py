@@ -17,6 +17,11 @@ from cs744_pytorch_distributed_tutorial_tpu.data.cifar10 import (
     synthetic_cifar10,
 )
 from cs744_pytorch_distributed_tutorial_tpu.data.loader import BatchLoader
+from cs744_pytorch_distributed_tutorial_tpu.data.native_batcher import gather_rows
+from cs744_pytorch_distributed_tutorial_tpu.data.prefetch import (
+    PrefetchIterator,
+    prefetch,
+)
 from cs744_pytorch_distributed_tutorial_tpu.data.sampler import ShardedSampler
 from cs744_pytorch_distributed_tutorial_tpu.data.text import synthetic_tokens
 
@@ -30,7 +35,10 @@ __all__ = [
     "eval_batch",
     "normalize",
     "random_crop_flip",
+    "gather_rows",
     "load_cifar10",
+    "prefetch",
+    "PrefetchIterator",
     "synthetic_cifar10",
     "synthetic_tokens",
 ]

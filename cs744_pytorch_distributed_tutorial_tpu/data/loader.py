@@ -19,6 +19,7 @@ from typing import Iterator
 import jax
 import numpy as np
 
+from cs744_pytorch_distributed_tutorial_tpu.data.native_batcher import gather_rows
 from cs744_pytorch_distributed_tutorial_tpu.data.sampler import (
     epoch_permutation,
     wrap_pad,
@@ -110,7 +111,9 @@ class BatchLoader:
         order = wrap_pad(order, len(self) * bsz)
         for b in range(len(self)):
             idx = order[b * bsz : (b + 1) * bsz]
-            yield self._put_global(self.images[idx], self.labels[idx])
+            yield self._put_global(
+                gather_rows(self.images, idx), gather_rows(self.labels, idx)
+            )
 
     def epoch_padded(
         self, epoch: int
@@ -127,4 +130,6 @@ class BatchLoader:
             mask[:n_real] = 1.0
             if n_real < bsz:
                 idx = np.concatenate([idx, np.zeros(bsz - n_real, dtype=idx.dtype)])
-            yield self._put_global(self.images[idx], self.labels[idx], mask)
+            yield self._put_global(
+                gather_rows(self.images, idx), gather_rows(self.labels, idx), mask
+            )

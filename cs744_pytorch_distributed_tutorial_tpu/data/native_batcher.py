@@ -1,0 +1,80 @@
+"""ctypes bridge to the native batch-assembly core.
+
+``gather_rows`` is the hot host-side op of the input pipeline: assemble a
+batch by gathering example rows into one contiguous buffer (the torch
+collate path the reference gets from libtorch via its DataLoader,
+``master/part1/part1.py:80-93``). Dispatches to the multithreaded C++
+implementation (``native/batcher.cpp``) when the compiler/artifact is
+available, else to ``np.take`` — identical results either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.native import load_library
+
+_DEFAULT_THREADS = min(os.cpu_count() or 1, 8)
+
+
+def _configured(lib):
+    lib.gather_u8.restype = ctypes.c_int
+    lib.gather_u8.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+    ]
+    lib.gather_i32.restype = ctypes.c_int
+    lib.gather_i32.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int,
+    ]
+    return lib
+
+
+_LIB = None
+_LIB_READY = False
+
+
+def _lib():
+    global _LIB, _LIB_READY
+    if not _LIB_READY:
+        raw = load_library("batcher")
+        _LIB = _configured(raw) if raw is not None else None
+        _LIB_READY = True
+    return _LIB
+
+
+def gather_rows(
+    array: np.ndarray, indices: np.ndarray, *, threads: int = _DEFAULT_THREADS
+) -> np.ndarray:
+    """out[i] = array[indices[i]] for C-contiguous uint8/int32 arrays.
+
+    Equivalent to ``np.take(array, indices, axis=0)``; the native path
+    parallelizes the row memcpys. Any precondition the native core can't
+    serve (dtype, layout, missing compiler) silently routes to NumPy.
+    """
+    lib = _lib()
+    idx = np.ascontiguousarray(indices, dtype=np.int64)
+    usable = (
+        lib is not None
+        and array.flags.c_contiguous
+        and array.dtype in (np.uint8, np.int32)
+    )
+    if not usable:
+        return np.take(array, idx, axis=0)
+    n = array.shape[0]
+    row_elems = int(np.prod(array.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx), *array.shape[1:]), dtype=array.dtype)
+    # gather_u8 takes row size in BYTES (== elems for uint8); gather_i32
+    # takes it in elements and scales internally.
+    fn = lib.gather_u8 if array.dtype == np.uint8 else lib.gather_i32
+    rc = fn(
+        array.ctypes.data, n, row_elems,
+        idx.ctypes.data, len(idx), out.ctypes.data, threads,
+    )
+    if rc != 0:  # defensive: bad index should be impossible from our samplers
+        return np.take(array, idx, axis=0)
+    return out

@@ -1,0 +1,82 @@
+"""Background prefetch: overlap host batch assembly with device compute.
+
+The reference overlaps input work with training via DataLoader worker
+processes and pinned staging memory (``num_workers=2, pin_memory=True``,
+``master/part1/part1.py:80-93``). The TPU-native shape of the same idea:
+a producer thread runs the loader (index plan -> native gather ->
+``device_put`` into the sharded layout) ``depth`` batches ahead, so the
+host stages batch N+1 while the chip runs batch N. JAX dispatch is
+already async on the compute side; the thread covers the host-side
+assembly+transfer latency that would otherwise serialize with it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+
+_STOP = object()
+
+
+class PrefetchIterator(Iterator[T]):
+    """Wrap any iterator; a daemon thread keeps ``depth`` items ready.
+
+    Exceptions in the producer re-raise at the consuming ``next()`` call.
+    ``close()`` (or garbage collection of the iterator) stops the thread.
+    """
+
+    def __init__(self, iterable: Iterable[T], depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(iterable),), daemon=True
+        )
+        self._thread.start()
+
+    def _offer(self, item) -> bool:
+        """Blocking put that still honors close(); True if enqueued."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it: Iterator[T]) -> None:
+        try:
+            for item in it:
+                if not self._offer(item):
+                    return
+            self._offer(_STOP)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._offer(e)
+
+    def __iter__(self) -> "PrefetchIterator[T]":
+        return self
+
+    def __next__(self) -> T:
+        item = self._q.get()
+        if item is _STOP:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing
+        self.close()
+
+
+def prefetch(iterable: Iterable[T], depth: int = 2) -> Iterator[T]:
+    """Functional spelling: ``for batch in prefetch(loader.epoch(e)):``."""
+    if depth == 0:
+        return iter(iterable)
+    return PrefetchIterator(iterable, depth)

@@ -1,0 +1,17 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's native surface is all in its dependencies — libtorch
+ATen, Gloo, torchvision C extensions, DataLoader worker processes
+(SURVEY §2.2). The compute path here is XLA/Pallas; this package holds
+the host-side runtime pieces that warrant native code, compiled on first
+use with the baked-in g++ (no pybind11 in the image; bindings are
+ctypes over an ``extern "C"`` surface). Every consumer has a pure-NumPy
+fallback, so the framework works even where no compiler exists.
+"""
+
+from cs744_pytorch_distributed_tutorial_tpu.native.build import (
+    load_library,
+    native_available,
+)
+
+__all__ = ["load_library", "native_available"]

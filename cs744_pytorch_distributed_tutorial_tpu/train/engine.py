@@ -45,6 +45,7 @@ from cs744_pytorch_distributed_tutorial_tpu.data.augment import (
     augment_train_batch,
     eval_batch,
 )
+from cs744_pytorch_distributed_tutorial_tpu.data.prefetch import prefetch
 from cs744_pytorch_distributed_tutorial_tpu.models import get_model
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -109,6 +110,13 @@ class Trainer:
         self.log = get_logger()
         self._sync_fn = get_sync(cfg.sync)
         self._check_vma = cfg.sync not in UNCHECKED_REPLICATION
+        self.sync_monitor = None
+        if cfg.debug_sync_check:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
+                DivergenceMonitor,
+            )
+
+            self.sync_monitor = DivergenceMonitor()
         self._build_steps()
 
     # ------------------------------------------------------------------ build
@@ -179,6 +187,20 @@ class Trainer:
                 )(params_local)
                 grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
                 loss = lax.pmean(local_loss, DATA_AXIS)
+
+            if self.sync_monitor is not None:
+                from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
+                    tree_checksum,
+                )
+
+                # Post-sync grads must be identical on every replica; the
+                # host-side monitor verifies it (utils/debug.py).
+                jax.debug.callback(
+                    self.sync_monitor.callback,
+                    state.step,
+                    lax.axis_index(DATA_AXIS),
+                    tree_checksum(grads),
+                )
 
             if cfg.fused_optimizer:
                 new_params, new_opt = tx.apply(state.params, state.opt_state, grads)
@@ -354,7 +376,9 @@ class Trainer:
 
         for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
             timer.start()
-            for batch_idx, (x, y) in enumerate(train_loader.epoch(epoch)):
+            for batch_idx, (x, y) in enumerate(
+                prefetch(train_loader.epoch(epoch), cfg.prefetch_depth)
+            ):
                 state, metrics = self.train_step(state, x, y, base_key)
                 # Fetch the loss value only while timing or logging needs
                 # it — otherwise leave dispatch fully async so the host
@@ -378,6 +402,10 @@ class Trainer:
                 steps_done += 1
                 if ckpt and cfg.checkpoint_every and steps_done % cfg.checkpoint_every == 0:
                     ckpt.save(state)
+            if self.sync_monitor is not None:
+                # Epoch boundary: fence in-flight debug callbacks and fail
+                # loudly if any replica drifted (utils/debug.py).
+                self.sync_monitor.assert_in_sync()
             eval_metrics = self.evaluate(state, test_loader)
             history["eval"].append(eval_metrics)
             self.log.info(
@@ -393,7 +421,9 @@ class Trainer:
 
     def evaluate(self, state: TrainState, test_loader: BatchLoader) -> dict[str, float]:
         total_loss, total_correct, total_count = 0.0, 0, 0
-        for x, y, mask in test_loader.epoch_padded(0):
+        for x, y, mask in prefetch(
+            test_loader.epoch_padded(0), self.cfg.prefetch_depth
+        ):
             m = self.eval_step(state, x, y, mask)
             total_loss += float(m["loss_sum"])
             total_correct += int(m["correct"])

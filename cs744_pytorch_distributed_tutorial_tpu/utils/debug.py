@@ -1,0 +1,111 @@
+"""Replica-divergence detection — the SPMD answer to race detection.
+
+The reference avoids data races by construction: every collective is
+synchronous (``async_op=False`` at ``master/part2a/part2a.py:44,52``;
+immediate ``req.wait()`` on each p2p op,
+``master/part2a/part2a_extra.py:45-58``) — but nothing ever *verifies*
+that the four ranks' parameters stayed in lockstep (SURVEY §5.2). In
+SPMD the analogous failure is replica divergence: a wrong or missing
+gradient sync leaves each device training its own drifting model while
+every step "succeeds" (exactly the bug class the LM engine's
+``check_vma=False`` pitfall produces — see ``train/lm.py``).
+
+``DivergenceMonitor`` detects it at run time: a ``jax.debug.callback``
+inside the jitted step streams a per-replica checksum of the synced
+gradients to the host, where the monitor compares replicas per step.
+Cost is one scalar per replica per step; enable with
+``TrainConfig(debug_sync_check=True)`` — the Trainer then checks the
+monitor at each epoch boundary and raises on divergence.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_checksum(tree) -> jax.Array:
+    """Order-stable scalar fingerprint of a pytree: sum of per-leaf L1
+    norms. Identical synced gradients => identical checksums; any
+    per-replica drift shows up after a step or two."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.abs(leaf.astype(jnp.float32)).sum() for leaf in leaves)
+
+
+class DivergenceMonitor:
+    """Streams (step, replica, checksum) records; flags disagreement.
+
+    Divergence is evaluated incrementally on ``record`` against the
+    step's first-seen replica, so memory stays bounded: per-step records
+    older than ``window`` steps are pruned (divergent step ids are kept
+    forever — they are the findings). Thread-safe: ``jax.debug.callback``
+    may fire from runtime threads.
+    """
+
+    def __init__(self, rtol: float = 1e-6, window: int = 4096):
+        self.rtol = rtol
+        self.window = window
+        self._lock = threading.Lock()
+        self._records: OrderedDict[int, dict[int, float]] = OrderedDict()
+        self._divergent: set[int] = set()
+        self._steps_seen = 0
+
+    def record(self, step: int, replica: int, checksum: float) -> None:
+        step, replica, checksum = int(step), int(replica), float(checksum)
+        with self._lock:
+            by_replica = self._records.get(step)
+            if by_replica is None:
+                by_replica = self._records[step] = {}
+                self._steps_seen += 1
+                while len(self._records) > self.window:
+                    self._records.popitem(last=False)
+            if not math.isfinite(checksum):
+                self._divergent.add(step)
+            elif by_replica:
+                ref = next(iter(by_replica.values()))
+                if abs(checksum - ref) > self.rtol * max(abs(ref), 1.0):
+                    self._divergent.add(step)
+            by_replica[replica] = checksum
+
+    def callback(self, step, replica, checksum) -> None:
+        """Signature taken by ``jax.debug.callback`` inside the step."""
+        self.record(step, replica, checksum)
+
+    @staticmethod
+    def flush() -> None:
+        """Wait for in-flight debug callbacks: delivery is asynchronous,
+        so checks must fence first or they miss the most recent steps."""
+        jax.effects_barrier()
+
+    @property
+    def steps_recorded(self) -> int:
+        self.flush()
+        with self._lock:
+            return self._steps_seen
+
+    def replicas_seen(self, step: int) -> int:
+        self.flush()
+        with self._lock:
+            return len(self._records.get(int(step), ()))
+
+    def divergent_steps(self) -> list[int]:
+        """Steps where any replica disagreed beyond rtol or reported a
+        non-finite checksum."""
+        self.flush()
+        with self._lock:
+            return sorted(self._divergent)
+
+    def assert_in_sync(self) -> None:
+        bad = self.divergent_steps()
+        if bad:
+            raise AssertionError(
+                f"replica divergence detected at steps {bad[:10]}"
+                + ("..." if len(bad) > 10 else "")
+                + " — gradient sync is broken or numerics are non-finite"
+            )

@@ -1,0 +1,49 @@
+"""Tracing/profiling — the subsystem the reference lacks (SURVEY §5.1).
+
+The reference's only instrumentation is wall-clock deltas between
+``datetime.now()`` calls printed at batch 10 (``master/part1/part1.py:39-44``),
+which on an async-dispatch device measures dispatch, not compute. Here:
+real profiler traces (XLA/TPU timeline viewable in TensorBoard /
+Perfetto) plus named annotations that show up on the trace, layered over
+``jax.profiler``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a device trace for the enclosed region.
+
+    Usage::
+
+        with profiling.trace("/tmp/trace"):
+            state, _ = trainer.train_step(state, x, y, key)
+            jax.block_until_ready(state.params)
+
+    View with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Label a host-side region so it appears on the profiler timeline::
+
+        with profiling.annotate("epoch-0-input"):
+            batch = next(loader)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_annotation(name: str, step: int):
+    """Step marker used by TensorBoard's per-step analysis."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
