@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fused-optimizer", action="store_true", default=None,
                    help="use the Pallas fused SGD kernel (ops/fused_sgd.py)")
     p.add_argument("--log-every", type=int, default=None)
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="batches staged ahead by the input pipeline (0 disables)")
+    p.add_argument("--debug-sync-check", action="store_true", default=None,
+                   help="stream per-replica grad checksums and fail on divergence")
     p.add_argument("--checkpoint-dir", default=None)
     # init_process mirror (master/part2a/part2a.py:80-85)
     p.add_argument("--coordinator", dest="coordinator_address", default=None,
@@ -84,6 +88,8 @@ _ARG_TO_FIELD = {
     "compute_dtype": "compute_dtype",
     "fused_optimizer": "fused_optimizer",
     "log_every": "log_every",
+    "prefetch_depth": "prefetch_depth",
+    "debug_sync_check": "debug_sync_check",
     "checkpoint_dir": "checkpoint_dir",
     "coordinator_address": "coordinator_address",
     "num_processes": "num_processes",

@@ -1,6 +1,16 @@
-"""Utilities: logging, step timing, checkpointing."""
+"""Utilities: logging, step timing, checkpointing, profiling, debug."""
 
+from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
+    DivergenceMonitor,
+    tree_checksum,
+)
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger, rank_zero_only
 from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
 
-__all__ = ["get_logger", "rank_zero_only", "StepTimer"]
+__all__ = [
+    "DivergenceMonitor",
+    "get_logger",
+    "rank_zero_only",
+    "StepTimer",
+    "tree_checksum",
+]
