@@ -32,7 +32,18 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
     ulysses_attention,
 )
 
-ATTENTION_IMPLS = ("dense", "ring", "ulysses")
+ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
+
+
+def default_flash_interpret() -> bool:
+    """The Pallas kernel Mosaic-compiles only on TPU backends (incl. this
+    environment's 'axon' plugin); interpret elsewhere. This probes the
+    global default backend — when the computation targets a non-default
+    device set (e.g. a CPU test mesh on a TPU host), set the module's
+    ``flash_interpret`` field from the mesh instead (as LMTrainer does)."""
+    import jax
+
+    return jax.default_backend() not in ("tpu", "axon")
 
 
 class Attention(nn.Module):
@@ -44,9 +55,14 @@ class Attention(nn.Module):
     seq_axis: str | None = None
     seq_axis_size: int = 1
     causal: bool = True
+    flash_interpret: bool | None = None  # None = probe default backend
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if self.impl not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"unknown attention impl {self.impl!r}; choose from {ATTENTION_IMPLS}"
+            )
         b, t, d_model = x.shape
         if d_model % self.num_heads:
             raise ValueError(
@@ -59,7 +75,19 @@ class Attention(nn.Module):
         q, k, v = (a.reshape(shape) for a in (q, k, v))
 
         if self.seq_axis is None or self.seq_axis_size == 1:
-            out = dense_attention(q, k, v, causal=self.causal)
+            if self.impl == "flash":
+                from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
+                    flash_attention,
+                )
+
+                interpret = (
+                    self.flash_interpret
+                    if self.flash_interpret is not None
+                    else default_flash_interpret()
+                )
+                out = flash_attention(q, k, v, self.causal, 128, 128, interpret)
+            else:
+                out = dense_attention(q, k, v, causal=self.causal)
         elif self.impl == "ring":
             out = ring_attention(
                 q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
@@ -68,15 +96,11 @@ class Attention(nn.Module):
             out = ulysses_attention(
                 q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
             )
-        elif self.impl == "dense":
+        else:  # dense/flash on a sequence-sharded axis
             raise ValueError(
-                "impl='dense' cannot run on a sequence-sharded axis (no "
-                "communication to see the full sequence); use 'ring' or "
+                f"impl={self.impl!r} cannot run on a sequence-sharded axis "
+                "(no communication to see the full sequence); use 'ring' or "
                 "'ulysses', or set seq_axis=None"
-            )
-        else:
-            raise ValueError(
-                f"unknown attention impl {self.impl!r}; choose from {ATTENTION_IMPLS}"
             )
         out = out.reshape(b, t, d_model).astype(self.dtype)
         return nn.Dense(d_model, use_bias=False, dtype=self.dtype)(out)
@@ -90,6 +114,7 @@ class Block(nn.Module):
     seq_axis: str | None = None
     seq_axis_size: int = 1
     causal: bool = True
+    flash_interpret: bool | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -101,6 +126,7 @@ class Block(nn.Module):
             seq_axis=self.seq_axis,
             seq_axis_size=self.seq_axis_size,
             causal=self.causal,
+            flash_interpret=self.flash_interpret,
         )(h)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
@@ -128,6 +154,7 @@ class TransformerLM(nn.Module):
     seq_axis: str | None = None
     seq_axis_size: int = 1
     causal: bool = True
+    flash_interpret: bool | None = None
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -153,6 +180,7 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 seq_axis_size=self.seq_axis_size,
                 causal=self.causal,
+                flash_interpret=self.flash_interpret,
             )(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype)(x)

@@ -1,0 +1,164 @@
+"""Flash attention as a Pallas TPU kernel — the on-chip hot path.
+
+The reference has no attention (SURVEY §5.7); this kernel is the
+single-chip compute half of the framework's long-context story:
+``parallel/ring_attention.py`` moves K/V blocks BETWEEN chips over ICI,
+and this kernel is the within-chip blockwise attention that never
+materializes the [T, T] score matrix — scores live tile-at-a-time in
+VMEM, with the flash-style running (max, normalizer, accumulator) update.
+
+Layout: [B, T, H, D] (the model zoo's convention), computed per
+(batch*head) over a grid of query blocks. K/V for one (batch, head) ride
+in VMEM whole (T*D*4 bytes each — ~2 MB at T=4096, D=128, well inside
+the ~16 MB budget); the kernel loops over K blocks, and the causal
+variant prunes the loop to blocks at or below the query block's
+diagonal. Softmax statistics accumulate in float32 regardless of input
+dtype (bfloat16 inputs hit the MXU; the normalizer stays full precision).
+
+Differentiation: ``jax.custom_vjp`` with a recompute backward — the
+forward is the Pallas kernel, the backward re-derives gradients through
+the mathematically identical dense formulation (standard
+kernel-forward/XLA-backward split; the backward's [T, T] materialization
+is acceptable because training at long T runs under ring attention,
+where per-chip T_local is small).
+
+``interpret=True`` runs the same kernel on any backend for tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled builds; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+_NEG = -1e30
+
+
+def _kernel(causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = correction * l + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: stop at
+        # the query block's last row.
+        num_kb = (qi * block_q + block_q + block_k - 1) // block_k
+    else:
+        num_kb = t // block_k
+    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _pick_block(t: int, preferred: int) -> int:
+    if t % preferred == 0:
+        return preferred
+    b = min(t, preferred)
+    while t % b:
+        b -= 1
+    if b < min(t, 8):
+        # A degenerate divisor (worst case 1 when T is prime) would grid
+        # one sublane-padded row per step — orders of magnitude slower
+        # than dense. Refuse instead of silently crawling.
+        raise ValueError(
+            f"sequence length {t} has no block divisor >= 8 near {preferred}; "
+            "pad the sequence to a multiple of the block size"
+        )
+    return b
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise attention on [B, T, H, D] without the [T, T] matrix."""
+    return _forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _forward(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    block_q = _pick_block(t, block_q)
+    block_k = _pick_block(t, block_k)
+    scale = d**-0.5
+
+    def to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **spec_kw)
+    kv_spec = pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0), **spec_kw)
+
+    out = pl.pallas_call(
+        partial(_kernel, causal, block_k, scale),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, v.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        interpret=interpret,
+    )(qb, kb, vb)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    # Recompute backward through the canonical dense formulation — the
+    # exact semantics this kernel's forward reproduces, so the two can't
+    # drift apart.
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        dense_attention,
+    )
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cs744_pytorch_distributed_tutorial_tpu.config import resolve_dtype
 from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+    ATTENTION_IMPLS,
     TransformerLM,
 )
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
@@ -43,7 +44,7 @@ class LMConfig:
     d_model: int = 128
     d_ff: int = 512
     max_seq_len: int = 2048
-    attention_impl: str = "ring"  # ring | ulysses | dense
+    attention_impl: str = "ring"  # ring | ulysses | dense | flash (single-device)
     compute_dtype: str = "float32"  # "bfloat16" on real TPU runs
 
     data_parallel: int = 1
@@ -86,13 +87,24 @@ class LMTrainer:
                 "position indices would gather out of bounds (NaN on CPU, "
                 "silently clamped/wrong positions on TPU)"
             )
-        if cfg.attention_impl == "dense" and self.seq_size > 1:
+        if cfg.attention_impl not in ATTENTION_IMPLS:
             raise ValueError(
-                "attention_impl='dense' is incompatible with seq_parallel > 1 "
-                "(a sequence-sharded block cannot attend to the full sequence "
-                "without communication); use 'ring' or 'ulysses'"
+                f"unknown attention_impl {cfg.attention_impl!r}; "
+                f"choose from {ATTENTION_IMPLS}"
+            )
+        if cfg.attention_impl in ("dense", "flash") and self.seq_size > 1:
+            raise ValueError(
+                f"attention_impl={cfg.attention_impl!r} is incompatible with "
+                "seq_parallel > 1 (a sequence-sharded block cannot attend to "
+                "the full sequence without communication); use 'ring' or "
+                "'ulysses'"
             )
         dtype = resolve_dtype(cfg.compute_dtype)
+        # Interpret the Pallas flash kernel off-TPU, decided by the mesh
+        # the computation actually runs on (not the global default
+        # backend, which can differ on a TPU host driving a CPU mesh).
+        platforms = {d.platform for d in self.mesh.devices.flat}
+        flash_interpret = platforms.isdisjoint({"tpu", "axon"})
         self.model = TransformerLM(
             vocab_size=cfg.vocab_size,
             num_layers=cfg.num_layers,

@@ -104,3 +104,20 @@ def test_dense_attention_with_seq_parallel_rejected():
         LMTrainer(LMConfig(**SMALL, attention_impl="dense",
                            data_parallel=2, seq_parallel=4),
                   mesh=make_mesh({"data": 2, "seq": 4}))
+
+
+def test_flash_attention_lm_matches_dense_lm():
+    """Single-device LM with the Pallas flash kernel == dense eval loss."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens as st
+
+    tokens = st(4, 64, 64, seed=13)
+    mesh = make_mesh({"data": 1, "seq": 1}, devices=jax.devices()[:1])
+    losses = {}
+    for impl in ("dense", "flash"):
+        cfg = LMConfig(**SMALL, attention_impl=impl,
+                       data_parallel=1, seq_parallel=1)
+        tr = LMTrainer(cfg, mesh=mesh)
+        p, _ = tr.init()
+        x, y = tr.shard_batch(tokens)
+        losses[impl] = float(tr.eval_step(p, x, y)["loss"])
+    assert losses["flash"] == pytest.approx(losses["dense"], rel=1e-5)
