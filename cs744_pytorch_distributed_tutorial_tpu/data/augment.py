@@ -14,7 +14,6 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 # The reference's exact normalization constants (master/part1/part1.py:66-67).
 CIFAR10_MEAN = np.array([125.3, 123.0, 113.9], dtype=np.float32) / 255.0
@@ -29,37 +28,80 @@ def normalize(images: jax.Array) -> jax.Array:
     return (x - jnp.asarray(CIFAR10_MEAN)) / jnp.asarray(CIFAR10_STD)
 
 
-def _crop_flip_one(key: jax.Array, img: jax.Array) -> jax.Array:
-    h, w, c = img.shape
+def _crop_flip_selectors(key: jax.Array, n: int, h: int, w: int):
+    """Per-image one-hot row/column selector matrices for crop + flip.
+
+    Returns ``(rows [n,h,h+2P], cols [n,w,w+2P])`` in bfloat16 such that
+    contracting them against the padded batch performs, per image, a
+    RandomCrop(pad 4) and (with probability 1/2, folded into the column
+    permutation) a horizontal flip.
+    """
     k_h, k_w, k_f = jax.random.split(key, 3)
-    padded = jnp.pad(img, ((_PAD, _PAD), (_PAD, _PAD), (0, 0)))
-    off_h = jax.random.randint(k_h, (), 0, 2 * _PAD + 1)
-    off_w = jax.random.randint(k_w, (), 0, 2 * _PAD + 1)
-    cropped = lax.dynamic_slice(padded, (off_h, off_w, 0), (h, w, c))
-    return lax.cond(
-        jax.random.bernoulli(k_f),
-        lambda im: im[:, ::-1, :],
-        lambda im: im,
-        cropped,
+    off_h = jax.random.randint(k_h, (n,), 0, 2 * _PAD + 1)
+    off_w = jax.random.randint(k_w, (n,), 0, 2 * _PAD + 1)
+    flip = jax.random.bernoulli(k_f, shape=(n,))
+    rows = jax.nn.one_hot(
+        off_h[:, None] + jnp.arange(h)[None, :], h + 2 * _PAD, dtype=jnp.bfloat16
     )
+    col_idx = jnp.where(
+        flip[:, None], w - 1 - jnp.arange(w)[None, :], jnp.arange(w)[None, :]
+    )
+    cols = jax.nn.one_hot(
+        off_w[:, None] + col_idx, w + 2 * _PAD, dtype=jnp.bfloat16
+    )
+    return rows, cols
+
+
+def _crop_flip_matmul(key: jax.Array, images: jax.Array) -> jax.Array:
+    """RandomCrop(pad 4) + HFlip as two batched one-hot contractions.
+
+    A vmapped ``dynamic_slice`` crop lowers to per-image gathers, which
+    the TPU's VPU executes scalar-ish (measured ~21 ms for a 1024-image
+    batch — ~43% of the whole ResNet-18 train step). Re-expressed as two
+    batched matmuls against one-hot selector matrices, the same transform
+    rides the MXU in ~1 ms. uint8 values (<= 255) are exact in bfloat16
+    (8 significant bits), and a one-hot contraction selects a single
+    element per output — no accumulation error; output is bfloat16
+    holding exact integer pixel values.
+    """
+    n, h, w, c = images.shape
+    rows, cols = _crop_flip_selectors(key, n, h, w)
+    padded = jnp.pad(
+        images, ((0, 0), (_PAD, _PAD), (_PAD, _PAD), (0, 0))
+    ).astype(jnp.bfloat16)
+    # y[b,i,l,c] = sum_j rows[b,i,j] * padded[b,j,l,c]
+    y = jnp.einsum("bij,bjlc->bilc", rows, padded)
+    # out[b,i,k,c] = sum_l cols[b,k,l] * y[b,i,l,c]
+    return jnp.einsum("bkl,bilc->bikc", cols, y)
 
 
 @jax.jit
 def random_crop_flip(key: jax.Array, images: jax.Array) -> jax.Array:
     """Per-image RandomCrop(pad 4) + HFlip on an [N, H, W, C] batch.
 
-    One key per image (split from ``key``), vmapped — batched gathers the
-    MXU-adjacent VPU handles cheaply; no host-side per-sample Python.
+    MXU path (one-hot contractions, see ``_crop_flip_matmul``); returns
+    the input dtype. Exactness of the bfloat16 contraction requires
+    pixel values representable in 8 significant bits, so the input must
+    be an integer dtype with values <= 255 (CIFAR uint8); float inputs
+    would be silently truncated and are rejected.
     """
-    keys = jax.random.split(key, images.shape[0])
-    return jax.vmap(_crop_flip_one)(keys, images)
+    if not jnp.issubdtype(images.dtype, jnp.integer):
+        raise TypeError(
+            f"random_crop_flip expects uint8/integer pixel values, got "
+            f"{images.dtype}; the MXU one-hot path is only exact for "
+            "<=8-significant-bit values"
+        )
+    return _crop_flip_matmul(key, images).astype(images.dtype)
 
 
 @jax.jit
 def augment_train_batch(key: jax.Array, images: jax.Array) -> jax.Array:
     """Full train-time transform: crop + flip on raw uint8, then normalize
-    (the reference's transform_train pipeline, master/part1/part1.py:68-73)."""
-    return normalize(random_crop_flip(key, images))
+    (the reference's transform_train pipeline, master/part1/part1.py:68-73).
+
+    The crop/flip output stays bfloat16 (exact for uint8 values) and is
+    normalized directly — no round-trip through uint8."""
+    return normalize(_crop_flip_matmul(key, images))
 
 
 @jax.jit

@@ -114,6 +114,7 @@ class LMTrainer:
             max_seq_len=cfg.max_seq_len,
             dtype=dtype,
             attention_impl=cfg.attention_impl,
+            flash_interpret=flash_interpret,
             seq_axis=SEQ_AXIS,
             seq_axis_size=self.seq_size,
         )

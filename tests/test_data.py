@@ -125,6 +125,28 @@ def test_crop_flip_shapes_and_determinism():
     assert not np.array_equal(np.asarray(a), np.asarray(c))
 
 
+def test_crop_flip_output_is_a_valid_crop_window():
+    """The MXU (one-hot contraction) crop must produce, for every image,
+    exactly some 32x32 window of the pad-4 source, optionally h-flipped —
+    the semantics of torchvision RandomCrop(32, padding=4)+HFlip
+    (master/part1/part1.py:68-73)."""
+    rng = np.random.default_rng(3)
+    imgs = rng.integers(0, 256, (8, 32, 32, 3), dtype=np.uint8)
+    out = np.asarray(random_crop_flip(jax.random.key(7), jnp.asarray(imgs)))
+    pad = np.pad(imgs, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    for b in range(imgs.shape[0]):
+        candidates = [
+            win
+            for oh in range(9)
+            for ow in range(9)
+            for win in (
+                pad[b, oh : oh + 32, ow : ow + 32],
+                pad[b, oh : oh + 32, ow : ow + 32][:, ::-1],
+            )
+        ]
+        assert any(np.array_equal(out[b], c) for c in candidates), b
+
+
 def test_augment_train_batch_is_normalized():
     imgs = jnp.asarray(
         np.random.default_rng(0).integers(0, 256, (8, 32, 32, 3), dtype=np.uint8)
