@@ -19,6 +19,7 @@ the param tree is identical either way).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import flax.linen as nn
@@ -30,6 +31,10 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
     dense_attention,
     ring_attention,
     ulysses_attention,
+)
+from cs744_pytorch_distributed_tutorial_tpu.parallel.tensor import (
+    copy_to_tp_region,
+    reduce_from_tp_region,
 )
 
 ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
@@ -47,13 +52,25 @@ def default_flash_interpret() -> bool:
 
 
 class Attention(nn.Module):
-    """Multi-head self-attention; the comm pattern is a config knob."""
+    """Multi-head self-attention; the comm pattern is a config knob.
+
+    With ``tensor_axis`` set (Megatron-style tensor parallelism), each
+    device projects and attends over its contiguous slice of
+    ``num_heads // tensor_axis_size`` heads — q/k/v are column-parallel,
+    the output projection is row-parallel, and one psum per sublayer
+    (inside ``reduce_from_tp_region``) restores the replicated residual
+    stream. q/k/v are separate projections (not one fused 3x matmul) so
+    the global parameter layout is invariant to the tensor-axis size:
+    sharding a head-sliced kernel over devices is a plain column split.
+    """
 
     num_heads: int
     dtype: Any = jnp.float32
     impl: str = "dense"
     seq_axis: str | None = None
     seq_axis_size: int = 1
+    tensor_axis: str | None = None
+    tensor_axis_size: int = 1
     causal: bool = True
     flash_interpret: bool | None = None  # None = probe default backend
 
@@ -69,9 +86,24 @@ class Attention(nn.Module):
                 f"d_model {d_model} not divisible by num_heads {self.num_heads}"
             )
         head_dim = d_model // self.num_heads
-        qkv = nn.Dense(3 * d_model, use_bias=False, dtype=self.dtype)(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, t, self.num_heads, head_dim)
+        tp = self.tensor_axis is not None and self.tensor_axis_size > 1
+        if tp and self.num_heads % self.tensor_axis_size:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by tensor axis "
+                f"{self.tensor_axis_size}"
+            )
+        heads_local = (
+            self.num_heads // self.tensor_axis_size if tp else self.num_heads
+        )
+        if tp:
+            x = copy_to_tp_region(x, self.tensor_axis)
+        proj = partial(
+            nn.Dense, heads_local * head_dim, use_bias=False, dtype=self.dtype
+        )
+        q = proj(name="q")(x)
+        k = proj(name="k")(x)
+        v = proj(name="v")(x)
+        shape = (b, t, heads_local, head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
 
         if self.seq_axis is None or self.seq_axis_size == 1:
@@ -102,8 +134,13 @@ class Attention(nn.Module):
                 "(no communication to see the full sequence); use 'ring' or "
                 "'ulysses', or set seq_axis=None"
             )
-        out = out.reshape(b, t, d_model).astype(self.dtype)
-        return nn.Dense(d_model, use_bias=False, dtype=self.dtype)(out)
+        out = out.reshape(b, t, heads_local * head_dim).astype(self.dtype)
+        out = nn.Dense(d_model, use_bias=False, dtype=self.dtype, name="attn_out")(
+            out
+        )
+        if tp:
+            out = reduce_from_tp_region(out, self.tensor_axis)
+        return out
 
 
 class Block(nn.Module):
@@ -113,25 +150,51 @@ class Block(nn.Module):
     impl: str = "dense"
     seq_axis: str | None = None
     seq_axis_size: int = 1
+    tensor_axis: str | None = None
+    tensor_axis_size: int = 1
     causal: bool = True
     flash_interpret: bool | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        h = nn.LayerNorm(dtype=self.dtype)(x)
+        tp = self.tensor_axis is not None and self.tensor_axis_size > 1
+        if tp and self.d_ff % self.tensor_axis_size:
+            raise ValueError(
+                f"d_ff {self.d_ff} not divisible by tensor axis "
+                f"{self.tensor_axis_size}"
+            )
+        d_ff_local = self.d_ff // self.tensor_axis_size if tp else self.d_ff
+
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + Attention(
             num_heads=self.num_heads,
             dtype=self.dtype,
             impl=self.impl,
             seq_axis=self.seq_axis,
             seq_axis_size=self.seq_axis_size,
+            tensor_axis=self.tensor_axis,
+            tensor_axis_size=self.tensor_axis_size,
             causal=self.causal,
             flash_interpret=self.flash_interpret,
+            name="attn",
         )(h)
-        h = nn.LayerNorm(dtype=self.dtype)(x)
-        h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if tp:
+            h = copy_to_tp_region(h, self.tensor_axis)
+        # Column-parallel in, row-parallel out; the out bias is a separate
+        # parameter applied AFTER the tp psum (a row-parallel Dense's own
+        # bias would be summed tensor_axis_size times).
+        h = nn.Dense(d_ff_local, dtype=self.dtype, name="mlp_in")(h)
         h = nn.gelu(h)
-        return x + nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+        h = nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="mlp_out")(
+            h
+        )
+        if tp:
+            h = reduce_from_tp_region(h, self.tensor_axis)
+        bias = self.param(
+            "mlp_out_bias", nn.initializers.zeros_init(), (x.shape[-1],)
+        )
+        return x + h + bias.astype(self.dtype)
 
 
 class TransformerLM(nn.Module):
@@ -153,13 +216,17 @@ class TransformerLM(nn.Module):
     attention_impl: str = "ring"
     seq_axis: str | None = None
     seq_axis_size: int = 1
+    tensor_axis: str | None = None
+    tensor_axis_size: int = 1
     causal: bool = True
     flash_interpret: bool | None = None
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
         b, t_local = tokens.shape
-        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        x = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
+        )(tokens)
         # Global positions: a sequence-sharded block starts at the
         # device's offset along the seq axis, not at 0.
         offset = (
@@ -168,10 +235,10 @@ class TransformerLM(nn.Module):
             else 0
         )
         positions = offset + jnp.arange(t_local)
-        x = x + nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
-            positions
-        )
-        for _ in range(self.num_layers):
+        x = x + nn.Embed(
+            self.max_seq_len, self.d_model, dtype=self.dtype, name="pos_embed"
+        )(positions)
+        for i in range(self.num_layers):
             x = Block(
                 num_heads=self.num_heads,
                 d_ff=self.d_ff,
@@ -179,13 +246,50 @@ class TransformerLM(nn.Module):
                 impl=self.attention_impl,
                 seq_axis=self.seq_axis,
                 seq_axis_size=self.seq_axis_size,
+                tensor_axis=self.tensor_axis,
+                tensor_axis_size=self.tensor_axis_size,
                 causal=self.causal,
                 flash_interpret=self.flash_interpret,
+                name=f"block_{i}",
             )(x)
-        x = nn.LayerNorm(dtype=self.dtype)(x)
-        logits = nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
+        )(x)
         return logits.astype(jnp.float32)
 
 
 def transformer_lm(**kw: Any) -> TransformerLM:
     return TransformerLM(**kw)
+
+
+def lm_param_specs(params, tensor_axis: str | None):
+    """PartitionSpec tree for a ``TransformerLM`` param tree.
+
+    Maps each leaf to how its GLOBAL array splits over the tensor axis
+    (the shard_map in/out spec): column-parallel kernels (q/k/v,
+    ``mlp_in``) shard the output-feature dim, row-parallel kernels
+    (``attn_out``, ``mlp_out``) the input-feature dim, ``mlp_in``'s bias
+    the feature dim; embeddings, layernorms, ``lm_head`` and the
+    post-psum ``mlp_out_bias`` stay replicated. With ``tensor_axis=None``
+    everything is replicated (the non-tp layout).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    t = tensor_axis
+
+    def spec(path, leaf):
+        if t is None:
+            return P()
+        names = [getattr(k, "key", str(k)) for k in path]
+        module = names[-2] if len(names) >= 2 else ""
+        leaf_name = names[-1]
+        if module in ("q", "k", "v"):
+            return P(None, t)
+        if module in ("attn_out", "mlp_out"):
+            return P(t, None)
+        if module == "mlp_in":
+            return P(None, t) if leaf_name == "kernel" else P(t)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

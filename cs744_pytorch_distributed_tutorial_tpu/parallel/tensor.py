@@ -1,0 +1,67 @@
+"""Tensor-parallel region boundaries — the Megatron f/g conjugate pair.
+
+Megatron-style tensor parallelism splits each transformer sublayer into a
+column-parallel linear (output features sharded over the tensor axis, no
+communication in forward) followed by a row-parallel linear (input
+features sharded, partial outputs summed with one all-reduce). Getting
+the *backward* pass right needs the conjugate boundary functions:
+
+- ``copy_to_tp_region`` ("f"): identity forward, all-reduce backward.
+  Placed on the activation entering a column-parallel layer, so the
+  input gradient leaving the region is summed over the tensor shards —
+  every parameter upstream of the region then sees the full gradient.
+- ``reduce_from_tp_region`` ("g"): all-reduce forward, identity backward.
+  Placed on the partial output of a row-parallel layer; its replicated
+  cotangent is exactly what each shard's weight gradient needs.
+
+Both are explicit ``custom_vjp``s rather than bare ``lax.psum`` because
+the engines trace under ``shard_map(check_vma=False)`` (required by the
+axis-index-routed sequence-parallel collectives), where no replication
+analysis exists to pick the correct psum transpose automatically.
+
+No counterpart exists in the reference (data parallelism only, SURVEY
+§2.3); this is a beyond-parity capability of the TPU framework. The
+communication structure (one psum per sublayer, riding ICI) is the
+sharded-matmul recipe of the public scaling-book material.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x: jax.Array, axis_name: str) -> jax.Array:
+    """Identity forward; psum over ``axis_name`` on the backward pass."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tp_region(x: jax.Array, axis_name: str) -> jax.Array:
+    """psum over ``axis_name`` forward; identity on the backward pass."""
+    return lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tp_region.defvjp(_reduce_fwd, _reduce_bwd)

@@ -1,13 +1,15 @@
-"""LM training engine: data x sequence parallelism on one 2-D mesh.
+"""LM training engine: data x sequence x tensor parallelism on one mesh.
 
 The CIFAR engine (``train/engine.py``) reproduces the reference's
 data-parallel pedagogy; this engine is the long-context counterpart the
 reference never reaches: batch sharded along ``data``, sequence sharded
-along ``seq``, attention communicating over the ``seq`` axis (ring
-ppermute hops or Ulysses all-to-all — ``parallel/ring_attention.py``),
-gradients synced the part3/DDP way (differentiate the axis-meaned loss;
-the autodiff transpose inserts the psum over BOTH mesh axes, since params
-are replicated across the full mesh).
+along ``seq`` (ring ppermute hops or Ulysses all-to-all —
+``parallel/ring_attention.py``), and attention heads + FFN hidden units
+sharded along ``tensor`` (Megatron-style column/row-parallel sublayers —
+``parallel/tensor.py``, ``models/transformer.py``). Tensor-sharded
+parameters live and update as shards (their optimizer state too — the
+ZeRO-flavored consequence of tensor parallelism); replicated parameters
+get their gradients explicitly averaged over all mesh axes.
 """
 
 from __future__ import annotations
@@ -25,6 +27,7 @@ from cs744_pytorch_distributed_tutorial_tpu.config import resolve_dtype
 from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
     ATTENTION_IMPLS,
     TransformerLM,
+    lm_param_specs,
 )
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -32,6 +35,7 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
 )
 
 SEQ_AXIS = "seq"
+TENSOR_AXIS = "tensor"
 
 
 @dataclasses.dataclass
@@ -49,6 +53,7 @@ class LMConfig:
 
     data_parallel: int = 1
     seq_parallel: int = 1
+    tensor_parallel: int = 1
 
     global_batch_size: int = 8
     seq_len: int = 256  # tokens per sequence fed to the model
@@ -67,11 +72,16 @@ class LMTrainer:
         self.cfg = cfg
         if mesh is None:
             mesh = make_mesh(
-                {DATA_AXIS: cfg.data_parallel, SEQ_AXIS: cfg.seq_parallel}
+                {
+                    DATA_AXIS: cfg.data_parallel,
+                    SEQ_AXIS: cfg.seq_parallel,
+                    TENSOR_AXIS: cfg.tensor_parallel,
+                }
             )
         self.mesh = mesh
         self.data_size = mesh.shape[DATA_AXIS]
         self.seq_size = mesh.shape[SEQ_AXIS]
+        self.tensor_size = mesh.shape.get(TENSOR_AXIS, 1)
         if cfg.global_batch_size % self.data_size:
             raise ValueError(
                 f"global batch {cfg.global_batch_size} not divisible by "
@@ -99,6 +109,21 @@ class LMTrainer:
                 "the full sequence without communication); use 'ring' or "
                 "'ulysses'"
             )
+        if cfg.num_heads % self.tensor_size:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by tensor axis "
+                f"{self.tensor_size}"
+            )
+        if cfg.d_ff % self.tensor_size:
+            raise ValueError(
+                f"d_ff {cfg.d_ff} not divisible by tensor axis {self.tensor_size}"
+            )
+        heads_local = cfg.num_heads // self.tensor_size
+        if cfg.attention_impl == "ulysses" and heads_local % self.seq_size:
+            raise ValueError(
+                f"ulysses needs per-tensor-shard heads ({heads_local}) divisible "
+                f"by the seq axis ({self.seq_size})"
+            )
         dtype = resolve_dtype(cfg.compute_dtype)
         # Interpret the Pallas flash kernel off-TPU, decided by the mesh
         # the computation actually runs on (not the global default
@@ -117,14 +142,66 @@ class LMTrainer:
             flash_interpret=flash_interpret,
             seq_axis=SEQ_AXIS,
             seq_axis_size=self.seq_size,
+            tensor_axis=TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None,
+            tensor_axis_size=self.tensor_size,
         )
         self.tx = optax.adamw(cfg.learning_rate)
+        # Partition specs: how each GLOBAL param (and its optimizer state)
+        # splits over the tensor axis. Built once from the init shapes.
+        param_shapes = jax.eval_shape(
+            lambda: self._init_model().init(
+                jax.random.key(0), jnp.zeros(self._local_batch_shape(), jnp.int32)
+            )["params"]
+        )
+        self.param_specs = lm_param_specs(
+            param_shapes,
+            TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None,
+        )
+        self.opt_specs = optax.tree_map_params(
+            self.tx,
+            lambda _, spec: spec,
+            jax.eval_shape(self.tx.init, param_shapes),
+            self.param_specs,
+            transform_non_params=lambda _: P(),
+        )
         self._build_steps()
+
+    def _init_model(self) -> TransformerLM:
+        """Clone for host-side init: no mesh axes in scope, GLOBAL shapes
+        (attention carries no parameters and tensor-sharded kernels are
+        initialized full-size then sharded by ``device_put``)."""
+        return self.model.clone(
+            seq_axis=None, seq_axis_size=1, tensor_axis=None, tensor_axis_size=1
+        )
+
+    def _local_batch_shape(self) -> tuple[int, int]:
+        return (
+            self.cfg.global_batch_size // self.data_size,
+            self.cfg.seq_len // self.seq_size,
+        )
 
     # ------------------------------------------------------------------ build
     def _build_steps(self) -> None:
         model, tx = self.model, self.tx
         batch_spec = P(DATA_AXIS, SEQ_AXIS)  # [batch, seq] token grids
+        param_specs, opt_specs = self.param_specs, self.opt_specs
+        has_tensor = TENSOR_AXIS in self.mesh.shape
+
+        def mean_over_replicas(x):
+            x = lax.pmean(lax.pmean(x, DATA_AXIS), SEQ_AXIS)
+            return lax.pmean(x, TENSOR_AXIS) if has_tensor else x
+
+        def sync_grad(g, spec):
+            # Data/seq axes replicate every param -> always average there.
+            # Tensor-SHARDED params (spec mentions the axis) have purely
+            # local grads — the Megatron f/g boundaries already routed the
+            # cross-shard terms — while replicated params' grads are full
+            # and identical across the tensor axis (the f-boundary psum),
+            # so the pmean is a drift guard, not a correction.
+            g = lax.pmean(lax.pmean(g, DATA_AXIS), SEQ_AXIS)
+            if has_tensor and TENSOR_AXIS not in spec:
+                g = lax.pmean(g, TENSOR_AXIS)
+            return g
 
         def local_step(params, opt_state, tokens, targets):
             def loss_fn(p):
@@ -134,7 +211,7 @@ class LMTrainer:
                 ).mean()
 
             # Differentiate the LOCAL loss, then average grads explicitly
-            # over both mesh axes. Under ``check_vma=False`` (which the
+            # per mesh axis. Under ``check_vma=False`` (which the
             # axis-index-routed attention collectives require) shard_map
             # disables the replication analysis that would let the AD
             # transpose insert the psum automatically — the engine's
@@ -143,14 +220,13 @@ class LMTrainer:
             # replicas. Autodiff through the ring/all-to-all collectives
             # is joint (ppermute transposes to the reverse ring), so each
             # device's grad already carries the cross-shard attention
-            # terms; the pmean supplies the final cross-device sum. Equal
-            # token counts per shard make pmean of local means the exact
-            # global mean.
+            # terms; ``sync_grad`` supplies the final cross-device
+            # averaging (spec-aware: tensor-sharded leaves stay local).
+            # Equal token counts per shard make pmean of local means the
+            # exact global mean.
             local_loss, grads = jax.value_and_grad(loss_fn)(params)
-            grads = jax.tree.map(
-                lambda g: lax.pmean(lax.pmean(g, DATA_AXIS), SEQ_AXIS), grads
-            )
-            loss = lax.pmean(lax.pmean(local_loss, DATA_AXIS), SEQ_AXIS)
+            grads = jax.tree.map(sync_grad, grads, param_specs)
+            loss = mean_over_replicas(local_loss)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, {"loss": loss}
@@ -159,8 +235,8 @@ class LMTrainer:
             jax.shard_map(
                 local_step,
                 mesh=self.mesh,
-                in_specs=(P(), P(), batch_spec, batch_spec),
-                out_specs=(P(), P(), {"loss": P()}),
+                in_specs=(param_specs, opt_specs, batch_spec, batch_spec),
+                out_specs=(param_specs, opt_specs, {"loss": P()}),
                 check_vma=False,
             ),
             donate_argnums=(0, 1),
@@ -171,13 +247,13 @@ class LMTrainer:
             local = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             ).mean()
-            return {"loss": lax.pmean(lax.pmean(local, DATA_AXIS), SEQ_AXIS)}
+            return {"loss": mean_over_replicas(local)}
 
         self.eval_step = jax.jit(
             jax.shard_map(
                 local_eval,
                 mesh=self.mesh,
-                in_specs=(P(), batch_spec, batch_spec),
+                in_specs=(param_specs, batch_spec, batch_spec),
                 out_specs={"loss": P()},
                 check_vma=False,
             )
@@ -185,22 +261,30 @@ class LMTrainer:
 
     # ------------------------------------------------------------------ state
     def init(self, seed: int | None = None):
-        """Host-side init: attention carries no parameters, so a
-        ``seq_axis=None`` clone yields the identical param tree without
-        needing mesh axes in scope."""
+        """Host-side init at GLOBAL shapes (the ``_init_model`` clone has
+        no mesh axes in scope), then laid out per the partition specs:
+        tensor-sharded kernels split over the tensor axis, everything
+        else replicated. The same global params produce the same model
+        function at every tensor_parallel setting (tested)."""
         cfg = self.cfg
-        init_model = self.model.clone(seq_axis=None, seq_axis_size=1)
-        local_t = cfg.seq_len // self.seq_size
-        dummy = jnp.zeros(
-            (cfg.global_batch_size // self.data_size, local_t), jnp.int32
-        )
-        variables = init_model.init(
+        dummy = jnp.zeros(self._local_batch_shape(), jnp.int32)
+        variables = self._init_model().init(
             jax.random.key(cfg.seed if seed is None else seed), dummy
         )
         params = variables["params"]
         opt_state = self.tx.init(params)
-        rep = NamedSharding(self.mesh, P())
-        return jax.device_put(params, rep), jax.device_put(opt_state, rep)
+        mesh = self.mesh
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params,
+            self.param_specs,
+        )
+        opt_state = jax.tree.map(
+            lambda o, s: jax.device_put(o, NamedSharding(mesh, s)),
+            opt_state,
+            self.opt_specs,
+        )
+        return params, opt_state
 
     def shard_batch(self, tokens):
         """[B, seq_len + 1] host tokens -> (inputs, targets) global arrays
