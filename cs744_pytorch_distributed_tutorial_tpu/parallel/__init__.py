@@ -12,6 +12,12 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    PipelineLMConfig,
+    PipelineLMTrainer,
+    spmd_pipeline,
+)
 from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
     SYNC_STRATEGIES,
     get_sync,
@@ -20,10 +26,14 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
+    "PipelineLMConfig",
+    "PipelineLMTrainer",
     "batch_sharding",
     "initialize",
     "make_mesh",
     "replicated",
+    "spmd_pipeline",
     "SYNC_STRATEGIES",
     "get_sync",
 ]

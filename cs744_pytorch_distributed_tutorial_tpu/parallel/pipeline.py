@@ -1,0 +1,398 @@
+"""Pipeline parallelism: GPipe-style stage partitioning over a mesh axis.
+
+No counterpart exists in the reference (data parallelism only, SURVEY
+§2.3) — this is a beyond-parity capability, built from the same primitive
+the reference's p2p star teaches (`master/part2a/part2a_extra.py:41-58`):
+point-to-point neighbor transfer, here ``lax.ppermute`` hops along a
+``pipe`` mesh axis that on TPU hardware ride single ICI links.
+
+TPU-first design decisions:
+
+- **SPMD, not MPMD.** Every device runs the same program; the stage
+  asymmetry ("stage 0 injects, the last stage collects") is expressed
+  with ``lax.axis_index`` selects inside ``shard_map``, exactly how the
+  framework re-expresses the reference's master/slave dual source trees.
+- **The schedule is a ``lax.scan``.** A GPipe round of ``M`` microbatches
+  over ``S`` stages is ``M + S - 1`` identical ticks: each tick, every
+  stage applies its block stack to its current activation and the
+  activations rotate one hop toward the next stage. Static trip count,
+  no data-dependent control flow — XLA compiles one tick and loops it.
+- **The backward pipeline is free.** The schedule is differentiable
+  (``ppermute`` transposes to the reversed permutation, ``scan``
+  transposes to the reversed scan), so ``jax.grad`` of the pipelined
+  forward IS the reverse pipeline — no hand-written 1F1B schedule, the
+  AD transpose derives it. Bubble fraction matches GPipe:
+  ``(S-1)/(M+S-1)`` of ticks are warmup/drain.
+- **Stacked homogeneous stages.** Block parameters are stacked along a
+  leading layer dim and sharded over the pipe axis, so each stage owns
+  ``num_layers/S`` blocks and runs them with a local ``lax.scan`` —
+  one compiled block body regardless of depth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.tensor import (
+    copy_to_tp_region,
+    reduce_from_tp_region,
+)
+
+PIPE_AXIS = "pipe"
+
+
+# --------------------------------------------------------------------------
+# The schedule
+# --------------------------------------------------------------------------
+def spmd_pipeline(
+    stage_fn,
+    stage_params,
+    mb_inputs: jax.Array,
+    *,
+    axis_name: str,
+    num_stages: int,
+    num_microbatches: int,
+) -> jax.Array:
+    """Run ``mb_inputs`` through ``num_stages`` pipeline stages.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y``, shape-preserving; applied by
+        every stage to its current microbatch activation.
+      stage_params: this stage's parameter shard (the local view under
+        ``shard_map`` of a pytree sharded over ``axis_name``).
+      mb_inputs: ``[M, ...]`` microbatched activations entering stage 0,
+        replicated over the pipe axis.
+      axis_name: the pipe mesh axis.
+      num_stages / num_microbatches: static schedule dimensions.
+
+    Returns ``[M, ...]`` outputs of the last stage, psum-broadcast so
+    every device along the axis holds them (replicated — downstream loss
+    code needs no stage asymmetry).
+    """
+    s, m = num_stages, num_microbatches
+    if mb_inputs.shape[0] != m:
+        raise ValueError(
+            f"mb_inputs leading dim {mb_inputs.shape[0]} != num_microbatches {m}"
+        )
+    stage = lax.axis_index(axis_name)
+    fwd = [(i, i + 1) for i in range(s - 1)]  # one ICI hop toward the next stage
+
+    # Megatron "f" boundary on the pipeline input: identity forward, psum
+    # backward. Only stage 0 consumes mb_inputs (the where-select below
+    # zeroes every other stage's input cotangent), so params upstream of
+    # the pipeline (embeddings) would otherwise see their gradient on
+    # stage 0 alone — and the engine's pipe-axis drift-guard pmean would
+    # scale it by 1/S. The psum backward replicates the full input
+    # cotangent to every stage, keeping upstream grads genuinely
+    # replicated over the pipe axis.
+    mb_inputs = copy_to_tp_region(mb_inputs, axis_name)
+
+    state0 = jnp.zeros(mb_inputs.shape[1:], mb_inputs.dtype)
+    out0 = jnp.zeros_like(mb_inputs)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped during drain ticks, whose
+        # results are never recorded); other stages use what arrived.
+        inject = lax.dynamic_index_in_dim(
+            mb_inputs, jnp.minimum(t, m - 1), axis=0, keepdims=False
+        )
+        x = jnp.where(stage == 0, inject, state)
+        y = stage_fn(stage_params, x)
+        # The last stage records microbatch t-(S-1) once it has flowed
+        # through all S stages; earlier ticks (warmup) write nothing.
+        out_idx = jnp.clip(t - (s - 1), 0, m - 1)
+        prev = lax.dynamic_index_in_dim(outputs, out_idx, axis=0, keepdims=False)
+        write = jnp.logical_and(stage == s - 1, t >= s - 1)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, prev), out_idx, axis=0
+        )
+        if s > 1:
+            state = lax.ppermute(y, axis_name, perm=fwd)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state0, out0), jnp.arange(m + s - 1))
+    # Broadcast the last stage's buffer (other stages hold zeros-or-garbage
+    # that the mask drops). The boundary must be psum-forward /
+    # IDENTITY-backward (the Megatron "g" pair): downstream loss code runs
+    # replicated on every pipe device, so a plain psum — which transposes
+    # to psum under check_vma=False — would deliver S identical cotangent
+    # copies to the last stage and scale stage grads by S. With the g
+    # boundary exactly one copy enters the reverse pipeline, and the
+    # where-mask keeps it on the last stage.
+    return reduce_from_tp_region(
+        jnp.where(stage == s - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+
+
+# --------------------------------------------------------------------------
+# A pure-pytree transformer stack to pipeline
+# --------------------------------------------------------------------------
+def _layer_norm(x, scale, bias, eps=1e-6):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+#: The 12 leaves of one block's param dict (kept in sync with
+#: ``init_block_params``; the trainer's partition specs enumerate these).
+BLOCK_PARAM_NAMES = (
+    "ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+    "ln2_scale", "ln2_bias", "w1", "b1", "w2", "b2",
+)
+
+
+def init_block_params(key, d_model: int, d_ff: int) -> dict:
+    """One pre-LN transformer block (dense causal attention + GELU MLP).
+
+    Plain pytrees rather than a flax module: stage stacking/sharding and
+    the scan-over-layers want bare arrays with a leading layer dim.
+    """
+    k = jax.random.split(key, 6)
+    init = jax.nn.initializers.lecun_normal()
+    d = d_model
+    return {
+        "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+        "wq": init(k[0], (d, d)), "wk": init(k[1], (d, d)),
+        "wv": init(k[2], (d, d)), "wo": init(k[3], (d, d)),
+        "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+        "w1": init(k[4], (d, d_ff)), "b1": jnp.zeros((d_ff,)),
+        "w2": init(k[5], (d_ff, d)), "b2": jnp.zeros((d,)),
+    }
+
+
+def block_apply(p: dict, x: jax.Array, num_heads: int) -> jax.Array:
+    """[B, T, D] -> [B, T, D]; dense causal attention + MLP, pre-LN."""
+    b, t, d = x.shape
+    h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
+    q, k, v = (
+        (h @ p[w]).reshape(b, t, num_heads, d // num_heads) for w in ("wq", "wk", "wv")
+    )
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d // num_heads)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v)
+    x = x + attn.reshape(b, t, d) @ p["wo"]
+    h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
+    return x + jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+
+def stack_apply(stacked: dict, x: jax.Array, num_heads: int) -> jax.Array:
+    """Apply a stack of blocks (leading layer dim) with one scanned body."""
+    return lax.scan(lambda h, bp: (block_apply(bp, h, num_heads), None), x, stacked)[0]
+
+
+# --------------------------------------------------------------------------
+# The trainer: data x pipeline on one mesh
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class PipelineLMConfig:
+    """Causal-LM training run over a ``{"data": d, "pipe": s}`` mesh."""
+
+    vocab_size: int = 1024
+    num_layers: int = 4
+    num_heads: int = 4
+    d_model: int = 128
+    d_ff: int = 512
+    max_seq_len: int = 512
+
+    data_parallel: int = 1
+    pipeline_parallel: int = 2
+    num_microbatches: int = 2
+
+    global_batch_size: int = 8
+    seq_len: int = 64
+    learning_rate: float = 1e-3
+    seed: int = 0
+
+    def replace(self, **kw: Any) -> "PipelineLMConfig":
+        return dataclasses.replace(self, **kw)
+
+
+class PipelineLMTrainer:
+    """Jitted shard_map train step for a pipelined ``TransformerLM``-class
+    model on a ``{"data": d, "pipe": s}`` mesh.
+
+    Embedding / final-LN / LM-head parameters are replicated over the pipe
+    axis (their compute is cheap and redundant per stage — the SPMD cost
+    of avoiding dedicated embedding stages); the stacked block parameters
+    are sharded over it, ``num_layers/S`` blocks per stage.
+    """
+
+    def __init__(self, cfg: PipelineLMConfig, mesh=None):
+        self.cfg = cfg
+        if mesh is None:
+            mesh = make_mesh(
+                {DATA_AXIS: cfg.data_parallel, PIPE_AXIS: cfg.pipeline_parallel}
+            )
+        self.mesh = mesh
+        self.data_size = mesh.shape[DATA_AXIS]
+        self.pipe_size = mesh.shape[PIPE_AXIS]
+        if cfg.num_layers % self.pipe_size:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} not divisible by pipe axis "
+                f"{self.pipe_size}"
+            )
+        if cfg.global_batch_size % self.data_size:
+            raise ValueError(
+                f"global batch {cfg.global_batch_size} not divisible by data "
+                f"axis {self.data_size}"
+            )
+        local_batch = cfg.global_batch_size // self.data_size
+        if local_batch % cfg.num_microbatches:
+            raise ValueError(
+                f"per-device batch {local_batch} not divisible by "
+                f"num_microbatches {cfg.num_microbatches}"
+            )
+        if cfg.seq_len > cfg.max_seq_len:
+            raise ValueError(f"seq_len {cfg.seq_len} > max_seq_len {cfg.max_seq_len}")
+        self.param_specs = {
+            "embed": P(), "pos": P(),
+            "blocks": {k: P(PIPE_AXIS) for k in BLOCK_PARAM_NAMES},
+            "ln_f_scale": P(), "ln_f_bias": P(),
+            "head": P(),
+        }
+        self.tx = optax.adamw(cfg.learning_rate)
+        self.opt_specs = optax.tree_map_params(
+            self.tx,
+            lambda _, spec: spec,
+            jax.eval_shape(self.tx.init, jax.eval_shape(self._init_host, 0)),
+            self.param_specs,
+            transform_non_params=lambda _: P(),
+        )
+        self._build_step()
+
+    def _init_host(self, seed: int) -> dict:
+        cfg = self.cfg
+        key = jax.random.key(seed)
+        ke, kp, kh, kb = jax.random.split(key, 4)
+        init = jax.nn.initializers.normal(0.02)
+        blocks = jax.vmap(
+            lambda k: init_block_params(k, cfg.d_model, cfg.d_ff)
+        )(jax.random.split(kb, cfg.num_layers))
+        return {
+            "embed": init(ke, (cfg.vocab_size, cfg.d_model)),
+            "pos": init(kp, (cfg.max_seq_len, cfg.d_model)),
+            "blocks": blocks,
+            "ln_f_scale": jnp.ones((cfg.d_model,)),
+            "ln_f_bias": jnp.zeros((cfg.d_model,)),
+            "head": init(kh, (cfg.d_model, cfg.vocab_size)),
+        }
+
+    def init(self, seed: int | None = None):
+        """Host init at global shapes, laid out per the partition specs:
+        block stack split over the pipe axis, the rest replicated."""
+        params = self._init_host(self.cfg.seed if seed is None else seed)
+        opt_state = self.tx.init(params)
+        put = lambda tree, specs: jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), tree, specs
+        )
+        return put(params, self.param_specs), put(opt_state, self.opt_specs)
+
+    def _build_step(self) -> None:
+        cfg = self.cfg
+        s, m = self.pipe_size, cfg.num_microbatches
+        num_heads = cfg.num_heads
+        tx = self.tx
+        param_specs, opt_specs = self.param_specs, self.opt_specs
+
+        def forward(params, tokens):
+            b, t = tokens.shape
+            x = params["embed"][tokens] + params["pos"][:t]
+            mb = x.reshape(m, b // m, t, cfg.d_model)
+            out = spmd_pipeline(
+                lambda sp, h: stack_apply(sp, h, num_heads),
+                params["blocks"],
+                mb,
+                axis_name=PIPE_AXIS,
+                num_stages=s,
+                num_microbatches=m,
+            )
+            y = out.reshape(b, t, cfg.d_model)
+            y = _layer_norm(y, params["ln_f_scale"], params["ln_f_bias"])
+            return y @ params["head"]
+
+        def sync_grad(g, spec):
+            # Data-parallel average for every leaf; pipe-stage-sharded
+            # blocks keep their local stage grads, replicated leaves get a
+            # pipe-mean (their grads are identical per stage — the loss is
+            # computed from psum-broadcast logits — so this is drift
+            # protection, same stance as the LM engine's tensor axis).
+            g = lax.pmean(g, DATA_AXIS)
+            if PIPE_AXIS not in spec:
+                g = lax.pmean(g, PIPE_AXIS)
+            return g
+
+        def local_step(params, opt_state, tokens, targets):
+            def loss_fn(p):
+                logits = forward(p, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                ).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = jax.tree.map(sync_grad, grads, param_specs)
+            loss = lax.pmean(loss, DATA_AXIS)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {"loss": loss}
+
+        batch_spec = P(DATA_AXIS)
+        self.train_step = jax.jit(
+            jax.shard_map(
+                local_step,
+                mesh=self.mesh,
+                in_specs=(param_specs, opt_specs, batch_spec, batch_spec),
+                out_specs=(param_specs, opt_specs, {"loss": P()}),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+        self.forward_fn = jax.jit(
+            jax.shard_map(
+                forward,
+                mesh=self.mesh,
+                in_specs=(param_specs, batch_spec),
+                out_specs=batch_spec,
+                check_vma=False,
+            )
+        )
+
+    def shard_batch(self, tokens):
+        """[B, seq_len + 1] host tokens -> (inputs, targets), data-sharded."""
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        return (
+            jax.device_put(tokens[:, :-1], sharding),
+            jax.device_put(tokens[:, 1:], sharding),
+        )
+
+    def reference_forward(self, params_global, tokens):
+        """Unpipelined single-device forward on the SAME global params —
+        the parity oracle the pipeline is tested against."""
+        cfg = self.cfg
+        b, t = tokens.shape
+        x = params_global["embed"][tokens] + params_global["pos"][:t]
+        x = stack_apply(params_global["blocks"], x, cfg.num_heads)
+        x = _layer_norm(x, params_global["ln_f_scale"], params_global["ln_f_bias"])
+        return x @ params_global["head"]
+
+    def fit(self, tokens, steps: int):
+        cfg = self.cfg
+        params, opt_state = self.init()
+        losses: list[float] = []
+        n, b = len(tokens), cfg.global_batch_size
+        for step in range(steps):
+            lo = (step * b) % max(n - b + 1, 1)
+            x, y = self.shard_batch(tokens[lo : lo + b])
+            params, opt_state, metrics = self.train_step(params, opt_state, x, y)
+            losses.append(float(metrics["loss"]))
+        return params, opt_state, losses

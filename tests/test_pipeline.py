@@ -1,0 +1,207 @@
+"""Pipeline parallelism: schedule correctness, forward/grad parity, training.
+
+The parity oracle is the unpipelined single-device forward on the SAME
+global parameters — the property the reference could only establish by
+seed + eyeball across its four parts (SURVEY §4) is here a bit-level
+comparison between the pipelined and sequential executions.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+    DATA_AXIS,
+    PIPE_AXIS,
+    PipelineLMConfig,
+    PipelineLMTrainer,
+    spmd_pipeline,
+)
+
+
+def make_trainer(data=1, pipe=4, layers=4, microbatches=2, batch=8, **kw):
+    cfg = PipelineLMConfig(
+        vocab_size=64,
+        num_layers=layers,
+        num_heads=4,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=64,
+        data_parallel=data,
+        pipeline_parallel=pipe,
+        num_microbatches=microbatches,
+        global_batch_size=batch,
+        seq_len=16,
+        **kw,
+    )
+    mesh = make_mesh(
+        {DATA_AXIS: data, PIPE_AXIS: pipe}, devices=jax.devices()[: data * pipe]
+    )
+    return PipelineLMTrainer(cfg, mesh=mesh)
+
+
+def tokens_for(cfg, n=None, seed=0):
+    rng = np.random.default_rng(seed)
+    n = cfg.global_batch_size if n is None else n
+    return rng.integers(0, cfg.vocab_size, (n, cfg.seq_len + 1), dtype=np.int64)
+
+
+def test_spmd_pipeline_identity_stage():
+    """With identity-plus-constant stages, the schedule must deliver each
+    microbatch through all S stages exactly once: out = in + S."""
+    mesh = make_mesh({PIPE_AXIS: 4}, devices=jax.devices()[:4])
+    m = 3
+    x = jnp.arange(m * 8, dtype=jnp.float32).reshape(m, 8)
+
+    from jax.sharding import PartitionSpec as P
+
+    def run(mb):
+        return spmd_pipeline(
+            lambda _, h: h + 1.0,
+            jnp.zeros((1,)),  # unused stage params
+            mb,
+            axis_name=PIPE_AXIS,
+            num_stages=4,
+            num_microbatches=m,
+        )
+
+    out = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+        )
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) + 4.0)
+
+
+def test_forward_parity_vs_sequential():
+    """Pipelined forward over 4 stages == unpipelined forward, same params."""
+    tr = make_trainer(data=1, pipe=4, layers=4, microbatches=4)
+    params_global = tr._init_host(0)
+    params, _ = tr.init(0)
+    toks = tokens_for(tr.cfg)
+    x = jnp.asarray(toks[:, :-1])
+    got = np.asarray(tr.forward_fn(params, x))
+    want = np.asarray(tr.reference_forward(params_global, x))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_forward_invariant_to_microbatch_count():
+    """Microbatching is a schedule choice, not a numerics choice."""
+    outs = []
+    for m in (1, 2, 4):
+        tr = make_trainer(data=1, pipe=2, layers=4, microbatches=m)
+        params, _ = tr.init(0)
+        toks = tokens_for(tr.cfg)
+        outs.append(np.asarray(tr.forward_fn(params, jnp.asarray(toks[:, :-1]))))
+    np.testing.assert_allclose(outs[0], outs[1], atol=1e-5)
+    np.testing.assert_allclose(outs[0], outs[2], atol=1e-5)
+
+
+def test_grad_parity_vs_sequential():
+    """One pipelined train-step gradient == the sequential model's gradient
+    (the AD-derived reverse pipeline is exact, not approximate)."""
+    tr = make_trainer(data=1, pipe=4, layers=4, microbatches=2)
+    params_global = tr._init_host(0)
+    toks = tokens_for(tr.cfg)
+    x, y = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+
+    def ref_loss(p):
+        logits = tr.reference_forward(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    want = jax.grad(ref_loss)(params_global)
+
+    params, opt_state = tr.init(0)
+    xg, yg = tr.shard_batch(toks)
+
+    # Grab per-stage grads through a shard_map identical to the train
+    # step's loss (stage-sharded block grads come back as the global
+    # stacked tree via the out_specs).
+    from jax.sharding import PartitionSpec as P
+
+    def step_grads(p, tokens, targets):
+        def loss_fn(pp):
+            b, t = tokens.shape
+            cfg = tr.cfg
+            import cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline as pl
+
+            xx = pp["embed"][tokens] + pp["pos"][:t]
+            mb = xx.reshape(cfg.num_microbatches, b // cfg.num_microbatches, t, cfg.d_model)
+            out = pl.spmd_pipeline(
+                lambda sp, h: pl.stack_apply(sp, h, cfg.num_heads),
+                pp["blocks"],
+                mb,
+                axis_name=PIPE_AXIS,
+                num_stages=tr.pipe_size,
+                num_microbatches=cfg.num_microbatches,
+            )
+            yy = out.reshape(b, t, cfg.d_model)
+            yy = pl._layer_norm(yy, pp["ln_f_scale"], pp["ln_f_bias"])
+            logits = yy @ pp["head"]
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+
+        grads = jax.grad(loss_fn)(p)
+        # The trainer's sync path, verbatim: data-average everything,
+        # pipe-average replicated leaves (must be a no-op if the pipeline's
+        # f-boundary replicates upstream grads correctly — this is what
+        # catches a stage-0-only embed/pos gradient).
+        def sync(g, spec):
+            g = jax.lax.pmean(g, DATA_AXIS)
+            if PIPE_AXIS not in spec:
+                g = jax.lax.pmean(g, PIPE_AXIS)
+            return g
+
+        return jax.tree.map(sync, grads, tr.param_specs)
+
+    grads = jax.jit(
+        jax.shard_map(
+            step_grads,
+            mesh=tr.mesh,
+            in_specs=(tr.param_specs, P(DATA_AXIS), P(DATA_AXIS)),
+            out_specs=tr.param_specs,
+            check_vma=False,
+        )
+    )(params, xg, yg)
+
+    for path, g_want in jax.tree_util.tree_flatten_with_path(want)[0]:
+        g_got = grads
+        for k in path:
+            g_got = g_got[k.key]
+        np.testing.assert_allclose(
+            np.asarray(g_got), np.asarray(g_want), atol=5e-4, rtol=5e-3,
+            err_msg=f"grad mismatch at {path}",
+        )
+
+
+def test_training_reduces_loss_dp_x_pp():
+    """2-way data x 4-way pipe end-to-end training makes progress."""
+    tr = make_trainer(
+        data=2, pipe=4, layers=4, microbatches=2, batch=16, learning_rate=3e-3
+    )
+    rng = np.random.default_rng(1)
+    # Learnable structure: next token = (token + 1) mod vocab.
+    start = rng.integers(0, tr.cfg.vocab_size, (64, 1))
+    ramp = (start + np.arange(tr.cfg.seq_len + 1)) % tr.cfg.vocab_size
+    _, _, losses = tr.fit(ramp.astype(np.int64), steps=50)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="num_layers"):
+        make_trainer(pipe=4, layers=6)
+    with pytest.raises(ValueError, match="microbatches"):
+        make_trainer(data=2, pipe=2, batch=8, microbatches=3)
+
+
+def test_block_param_names_in_sync():
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        BLOCK_PARAM_NAMES,
+        init_block_params,
+    )
+
+    assert set(init_block_params(jax.random.key(0), 8, 8)) == set(BLOCK_PARAM_NAMES)
