@@ -20,6 +20,7 @@ from cs744_pytorch_distributed_tutorial_tpu.models.resnet import (
     resnet34,
     resnet50,
 )
+from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN, moe_aux_loss
 from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
     TransformerLM,
     transformer_lm,
@@ -88,6 +89,8 @@ def get_model(name: str, **kw: Any) -> nn.Module:
 __all__ = [
     "MODEL_REGISTRY",
     "get_model",
+    "MoEFFN",
+    "moe_aux_loss",
     "ResNet",
     "TinyCNN",
     "TransformerLM",

@@ -1,0 +1,165 @@
+"""Mixture-of-Experts FFN with expert parallelism via all-to-all.
+
+No counterpart exists in the reference (data parallelism over one dense
+VGG-11 is its whole scope, SURVEY §2.3) — this is the expert-parallel
+capability that completes the framework's dp/tp/pp/sp/ep strategy set.
+
+Design, TPU-first:
+
+- **Static shapes everywhere.** Token->expert routing is data-dependent,
+  which XLA cannot tile; the standard TPU answer is the capacity-slot
+  formulation (Switch Transformer / GShard): each expert has a fixed
+  number of slots ``C``, routing materializes as dense one-hot
+  ``dispatch``/``combine`` tensors, and the actual token movement is two
+  einsums — MXU work, not scatter/gather.
+- **Expert parallelism is one ``lax.all_to_all`` pair.** With experts
+  sharded over a mesh axis (here: the ``data`` axis — the standard
+  "EP over DP" layout), each device dispatches its local tokens into
+  per-expert slot blocks, one tiled all-to-all re-shards
+  experts->tokens so every device holds ALL slot blocks for ITS experts,
+  the expert FFNs run as one batched einsum over the local expert dim,
+  and the inverse all-to-all routes results home. Autodiff through
+  ``all_to_all`` transposes to the reverse all-to-all, so cross-device
+  gradient routing needs no hand-written backward.
+- **Overflow drops to the residual.** Tokens beyond an expert's capacity
+  get zero combine weight; the surrounding Block's residual connection
+  carries them through unchanged (standard Switch semantics).
+
+The router computes in float32 (softmax numerics), experts in the model
+compute dtype (bfloat16 on TPU -> MXU).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEFFN(nn.Module):
+    """Switch/GShard-style top-k routed FFN, optionally expert-parallel.
+
+    Called on ``x [B, T_local, D]``; returns the combined expert outputs
+    (zeros for dropped tokens — add to the residual stream). Sows the
+    load-balancing auxiliary loss into the ``"losses"`` collection as
+    ``moe_aux``.
+
+    With ``expert_axis`` set, the module must be traced inside
+    ``shard_map`` with that mesh axis in scope; each device then declares
+    only its ``num_experts // expert_axis_size`` local experts' parameters
+    (the trainer's partition specs shard the global ``[E, ...]`` arrays
+    over the axis). With ``expert_axis=None`` the same code computes all
+    experts locally — which also makes host-side ``init`` produce the
+    global parameter shapes.
+    """
+
+    num_experts: int
+    d_ff: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    expert_axis: str | None = None
+    expert_axis_size: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, d = x.shape
+        e = self.num_experts
+        k = self.top_k
+        if k < 1 or k > e:
+            raise ValueError(f"top_k {k} must be in [1, {e}]")
+        ep = self.expert_axis is not None and self.expert_axis_size > 1
+        if e % (self.expert_axis_size if ep else 1):
+            raise ValueError(
+                f"num_experts {e} not divisible by expert axis "
+                f"{self.expert_axis_size}"
+            )
+        e_local = e // self.expert_axis_size if ep else e
+        n = b * t
+        # Fixed slots per expert for THIS device's tokens; ceil so tiny
+        # test batches still route at least one token per expert.
+        capacity = max(1, int(-(-(k * n * self.capacity_factor) // e)))
+
+        tokens = x.reshape(n, d)
+
+        # ---- router (float32 end-to-end) --------------------------------
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="router",
+        )(tokens.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        topk_gate, topk_idx = lax.top_k(gates, k)  # [N, K]
+        if k > 1:
+            topk_gate = topk_gate / jnp.maximum(
+                topk_gate.sum(-1, keepdims=True), 1e-9
+            )
+
+        # Load-balancing aux loss (Switch eq. 4): experts should see equal
+        # token fractions f_e and equal mean router mass P_e.
+        top1 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+        aux = e * jnp.sum(top1.mean(0) * gates.mean(0))
+        self.sow("losses", "moe_aux", aux)
+
+        # ---- capacity-slot assignment (static shapes) -------------------
+        # Priority: rank-0 choices of every token beat rank-1 choices
+        # (k-major cumsum order), so top-1 routes are the last to drop.
+        onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [N, K, E]
+        flat = onehot.transpose(1, 0, 2).reshape(k * n, e)
+        pos = (jnp.cumsum(flat, axis=0) - 1.0).reshape(k, n, e)
+        pos_k = (pos.transpose(1, 0, 2) * onehot).sum(-1)  # [N, K]
+        keep = (pos_k < capacity).astype(jnp.float32)
+        routed = onehot * keep[..., None]  # [N, K, E]
+        slot = jax.nn.one_hot(
+            pos_k.astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [N, K, C]
+        dispatch = jnp.einsum("nke,nkc->nec", routed, slot)
+        combine = jnp.einsum("nk,nke,nkc->nec", topk_gate, routed, slot)
+
+        # ---- gather tokens into expert slot blocks (MXU einsum) ---------
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), tokens.astype(self.dtype)
+        )  # [E, C, D]
+
+        if ep:
+            # Re-shard experts -> tokens: every device ends up with the
+            # slot blocks of ITS e_local experts from ALL axis peers.
+            expert_in = lax.all_to_all(
+                expert_in, self.expert_axis, split_axis=0, concat_axis=1,
+                tiled=True,
+            )  # [E_local, S*C, D]
+
+        # ---- batched expert FFN -----------------------------------------
+        init = nn.initializers.lecun_normal()
+        w_in = self.param("w_in", init, (e_local, d, self.d_ff))
+        b_in = self.param("b_in", nn.initializers.zeros_init(), (e_local, self.d_ff))
+        w_out = self.param("w_out", init, (e_local, self.d_ff, d))
+        b_out = self.param("b_out", nn.initializers.zeros_init(), (e_local, d))
+        h = jnp.einsum(
+            "ecd,edf->ecf", expert_in, w_in.astype(self.dtype)
+        ) + b_in[:, None, :].astype(self.dtype)
+        h = nn.gelu(h)
+        out = jnp.einsum(
+            "ecf,efd->ecd", h, w_out.astype(self.dtype)
+        ) + b_out[:, None, :].astype(self.dtype)
+
+        if ep:
+            out = lax.all_to_all(
+                out, self.expert_axis, split_axis=1, concat_axis=0, tiled=True
+            )  # back to [E, C, D], slots owned by this device's tokens
+
+        # ---- scatter back + weight by gate ------------------------------
+        y = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), out)
+        return y.reshape(b, t, d)
+
+
+def moe_aux_loss(mutated_variables) -> jnp.ndarray:
+    """Sum every sown ``moe_aux`` value (one per MoE layer) from the
+    ``"losses"`` collection returned by ``apply(..., mutable=["losses"])``."""
+    losses = mutated_variables.get("losses", {})
+    leaves = jax.tree_util.tree_leaves(losses)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(leaves)

@@ -154,11 +154,21 @@ class Block(nn.Module):
     tensor_axis_size: int = 1
     causal: bool = True
     flash_interpret: bool | None = None
+    # MoE FFN (models/moe.py): num_experts > 0 replaces the dense MLP with
+    # a routed expert mixture, optionally expert-parallel over expert_axis.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    expert_axis: str | None = None
+    expert_axis_size: int = 1
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         tp = self.tensor_axis is not None and self.tensor_axis_size > 1
-        if tp and self.d_ff % self.tensor_axis_size:
+        # The MoE path never shards d_ff over the tensor axis (experts
+        # compute replicated), so the divisibility constraint applies to
+        # the dense FFN only.
+        if tp and self.num_experts == 0 and self.d_ff % self.tensor_axis_size:
             raise ValueError(
                 f"d_ff {self.d_ff} not divisible by tensor axis "
                 f"{self.tensor_axis_size}"
@@ -179,6 +189,24 @@ class Block(nn.Module):
             name="attn",
         )(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if self.num_experts > 0:
+            from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN
+
+            # Experts are NOT tensor-sharded: with a tensor axis in the
+            # mesh they compute replicated (identical activations in,
+            # replicated expert params), which keeps the EP all-to-all a
+            # pure expert_axis collective.
+            y = MoEFFN(
+                num_experts=self.num_experts,
+                d_ff=self.d_ff,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                expert_axis=self.expert_axis,
+                expert_axis_size=self.expert_axis_size,
+                name="moe",
+            )(h)
+            return x + y
         if tp:
             h = copy_to_tp_region(h, self.tensor_axis)
         # Column-parallel in, row-parallel out; the out bias is a separate
@@ -220,6 +248,11 @@ class TransformerLM(nn.Module):
     tensor_axis_size: int = 1
     causal: bool = True
     flash_interpret: bool | None = None
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    expert_axis: str | None = None
+    expert_axis_size: int = 1
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -250,6 +283,11 @@ class TransformerLM(nn.Module):
                 tensor_axis_size=self.tensor_axis_size,
                 causal=self.causal,
                 flash_interpret=self.flash_interpret,
+                num_experts=self.num_experts,
+                moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                expert_axis=self.expert_axis,
+                expert_axis_size=self.expert_axis_size,
                 name=f"block_{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -263,26 +301,30 @@ def transformer_lm(**kw: Any) -> TransformerLM:
     return TransformerLM(**kw)
 
 
-def lm_param_specs(params, tensor_axis: str | None):
+def lm_param_specs(params, tensor_axis: str | None, expert_axis: str | None = None):
     """PartitionSpec tree for a ``TransformerLM`` param tree.
 
-    Maps each leaf to how its GLOBAL array splits over the tensor axis
-    (the shard_map in/out spec): column-parallel kernels (q/k/v,
-    ``mlp_in``) shard the output-feature dim, row-parallel kernels
-    (``attn_out``, ``mlp_out``) the input-feature dim, ``mlp_in``'s bias
-    the feature dim; embeddings, layernorms, ``lm_head`` and the
-    post-psum ``mlp_out_bias`` stay replicated. With ``tensor_axis=None``
-    everything is replicated (the non-tp layout).
+    Maps each leaf to how its GLOBAL array splits over the mesh (the
+    shard_map in/out spec): column-parallel kernels (q/k/v, ``mlp_in``)
+    shard the output-feature dim over the tensor axis, row-parallel
+    kernels (``attn_out``, ``mlp_out``) the input-feature dim, ``mlp_in``'s
+    bias the feature dim; MoE expert params (``moe/{w,b}_{in,out}``) shard
+    their leading expert dim over ``expert_axis`` (the router stays
+    replicated); embeddings, layernorms, ``lm_head`` and the post-psum
+    ``mlp_out_bias`` stay replicated. With both axes ``None`` everything
+    is replicated.
     """
     from jax.sharding import PartitionSpec as P
 
     t = tensor_axis
 
     def spec(path, leaf):
-        if t is None:
-            return P()
         names = [getattr(k, "key", str(k)) for k in path]
         module = names[-2] if len(names) >= 2 else ""
+        if module == "moe" and expert_axis is not None:
+            return P(expert_axis)
+        if t is None:
+            return P()
         leaf_name = names[-1]
         if module in ("q", "k", "v"):
             return P(None, t)

@@ -55,6 +55,16 @@ class LMConfig:
     seq_parallel: int = 1
     tensor_parallel: int = 1
 
+    # MoE: num_experts > 0 swaps the dense FFN for a routed expert
+    # mixture (models/moe.py); expert_parallel shards the experts over
+    # the DATA axis (the standard EP-over-DP layout) with all-to-all
+    # token dispatch.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_expert_parallel: bool = False
+    moe_aux_coef: float = 0.01
+
     global_batch_size: int = 8
     seq_len: int = 256  # tokens per sequence fed to the model
     learning_rate: float = 1e-3
@@ -124,6 +134,14 @@ class LMTrainer:
                 f"ulysses needs per-tensor-shard heads ({heads_local}) divisible "
                 f"by the seq axis ({self.seq_size})"
             )
+        self.expert_parallel = bool(
+            cfg.moe_expert_parallel and cfg.moe_experts > 0 and self.data_size > 1
+        )
+        if self.expert_parallel and cfg.moe_experts % self.data_size:
+            raise ValueError(
+                f"moe_experts {cfg.moe_experts} not divisible by the data axis "
+                f"({self.data_size}) for expert parallelism"
+            )
         dtype = resolve_dtype(cfg.compute_dtype)
         # Interpret the Pallas flash kernel off-TPU, decided by the mesh
         # the computation actually runs on (not the global default
@@ -144,6 +162,11 @@ class LMTrainer:
             seq_axis_size=self.seq_size,
             tensor_axis=TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None,
             tensor_axis_size=self.tensor_size,
+            num_experts=cfg.moe_experts,
+            moe_top_k=cfg.moe_top_k,
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            expert_axis=DATA_AXIS if self.expert_parallel else None,
+            expert_axis_size=self.data_size if self.expert_parallel else 1,
         )
         self.tx = optax.adamw(cfg.learning_rate)
         # Partition specs: how each GLOBAL param (and its optimizer state)
@@ -156,6 +179,7 @@ class LMTrainer:
         self.param_specs = lm_param_specs(
             param_shapes,
             TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None,
+            DATA_AXIS if self.expert_parallel else None,
         )
         self.opt_specs = optax.tree_map_params(
             self.tx,
@@ -168,10 +192,15 @@ class LMTrainer:
 
     def _init_model(self) -> TransformerLM:
         """Clone for host-side init: no mesh axes in scope, GLOBAL shapes
-        (attention carries no parameters and tensor-sharded kernels are
-        initialized full-size then sharded by ``device_put``)."""
+        (attention carries no parameters; tensor- and expert-sharded
+        kernels are initialized full-size then sharded by ``device_put``)."""
         return self.model.clone(
-            seq_axis=None, seq_axis_size=1, tensor_axis=None, tensor_axis_size=1
+            seq_axis=None,
+            seq_axis_size=1,
+            tensor_axis=None,
+            tensor_axis_size=1,
+            expert_axis=None,
+            expert_axis_size=1,
         )
 
     def _local_batch_shape(self) -> tuple[int, int]:
@@ -186,13 +215,24 @@ class LMTrainer:
         batch_spec = P(DATA_AXIS, SEQ_AXIS)  # [batch, seq] token grids
         param_specs, opt_specs = self.param_specs, self.opt_specs
         has_tensor = TENSOR_AXIS in self.mesh.shape
+        data_size, seq_size = self.data_size, self.seq_size
+        aux_coef = self.cfg.moe_aux_coef
 
         def mean_over_replicas(x):
             x = lax.pmean(lax.pmean(x, DATA_AXIS), SEQ_AXIS)
             return lax.pmean(x, TENSOR_AXIS) if has_tensor else x
 
         def sync_grad(g, spec):
-            # Data/seq axes replicate every param -> always average there.
+            # Expert-SHARDED params (EP over the data axis, spec mentions
+            # DATA_AXIS): the all_to_all transpose already summed each
+            # shard's grad over its whole data row, so the remaining job
+            # is the sum over seq replicas and the 1/num_devices of the
+            # global-mean loss — psum(seq) / (data*seq), then the tensor
+            # drift-guard pmean (expert compute is replicated over tensor).
+            if DATA_AXIS in spec:
+                g = lax.psum(g, SEQ_AXIS) / (data_size * seq_size)
+                return lax.pmean(g, TENSOR_AXIS) if has_tensor else g
+            # Data/seq axes replicate every other param -> average there.
             # Tensor-SHARDED params (spec mentions the axis) have purely
             # local grads — the Megatron f/g boundaries already routed the
             # cross-shard terms — while replicated params' grads are full
@@ -205,10 +245,19 @@ class LMTrainer:
 
         def local_step(params, opt_state, tokens, targets):
             def loss_fn(p):
-                logits = model.apply({"params": p}, tokens)
-                return optax.softmax_cross_entropy_with_integer_labels(
+                # mutable=["losses"] collects each MoE layer's sown
+                # load-balancing aux term (empty when the FFNs are dense).
+                logits, mut = model.apply(
+                    {"params": p}, tokens, mutable=["losses"]
+                )
+                ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits, targets
                 ).mean()
+                from cs744_pytorch_distributed_tutorial_tpu.models.moe import (
+                    moe_aux_loss,
+                )
+
+                return ce + aux_coef * moe_aux_loss(mut)
 
             # Differentiate the LOCAL loss, then average grads explicitly
             # per mesh axis. Under ``check_vma=False`` (which the
