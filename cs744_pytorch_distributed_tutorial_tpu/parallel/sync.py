@@ -66,6 +66,9 @@ def _ring(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
 
 # ``auto`` maps to allreduce numerics; the engine treats it as "framework
 # inserts the sync" (DDP automation) rather than a user-plugged loop.
+# ``zero1`` is identity HERE because its reduce-scatter is fused into the
+# sharded-optimizer update (parallel/zero.py) — grads leave the loss
+# local and the averaging happens chunk-wise inside ``Zero1SGD.apply``.
 SYNC_STRATEGIES: dict[str, SyncFn] = {
     "none": _none,
     "allreduce": _allreduce,
@@ -73,12 +76,13 @@ SYNC_STRATEGIES: dict[str, SyncFn] = {
     "p2p_star": _p2p_star,
     "ring": _ring,
     "auto": _allreduce,
+    "zero1": _none,
 }
 
 #: Strategies whose outputs the VMA replication checker cannot statically
 #: prove replicated (axis_index-routed selects; ``all_gather`` outputs),
 #: so the enclosing ``shard_map`` needs ``check_vma=False``.
-UNCHECKED_REPLICATION = {"p2p_star", "ring", "gather_scatter"}
+UNCHECKED_REPLICATION = {"p2p_star", "ring", "gather_scatter", "zero1"}
 
 
 def get_sync(name: str) -> SyncFn:

@@ -1,0 +1,97 @@
+"""ZeRO-1: optimizer-state sharding over the data axis.
+
+The reference keeps a full optimizer replica per rank (plain SGD over a
+full model copy, ``master/part2a/part2a.py:127-128``; SURVEY §2.3 lists
+ZeRO/FSDP as absent) — this module is the beyond-parity capability that
+removes that redundancy, stage 1 of the ZeRO family expressed in the
+TPU-native collective set:
+
+- gradients are averaged with ``lax.psum_scatter`` (reduce-scatter), so
+  each data-parallel device receives only its 1/axis_size chunk of the
+  mean gradient — half the collective bytes of a full allreduce;
+- the SGD momentum buffer exists ONLY as that chunk per device
+  (``[axis_size, chunk]`` globally, sharded over the data axis);
+- each device applies the torch-SGD update rule (decay into grad, then
+  momentum trace — ``train/state.py``) to its chunk and one
+  ``lax.all_gather`` of the parameter *deltas* restores replicated
+  params.
+
+reduce_scatter + all_gather is exactly the decomposition of a ring
+allreduce, so the per-step communication volume matches ``allreduce``
+while optimizer memory drops from O(params) to O(params / axis_size) per
+device. Params themselves stay replicated (that is ZeRO-1's contract;
+param sharding would be ZeRO-3/FSDP).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Zero1SGD:
+    """SGD(momentum, weight-decay) with data-axis-sharded momentum.
+
+    ``init`` runs on host and returns GLOBAL momentum leaves of shape
+    ``[axis_size, chunk]`` (the trainer shards their leading dim over the
+    data axis); ``apply`` runs inside ``shard_map`` where each momentum
+    leaf arrives as the local ``[1, chunk]`` shard.
+    """
+
+    def __init__(
+        self,
+        learning_rate: float,
+        momentum: float,
+        weight_decay: float,
+        axis_name: str,
+        axis_size: int,
+    ):
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+
+    def _chunk(self, size: int) -> int:
+        return -(-size // self.axis_size)  # ceil
+
+    def init(self, params):
+        """Global momentum buffers: ``[axis_size, chunk]`` zeros per leaf."""
+        return jax.tree.map(
+            lambda p: jnp.zeros((self.axis_size, self._chunk(p.size)), p.dtype),
+            params,
+        )
+
+    def apply(self, params, momenta, grads):
+        """One ZeRO-1 step on local LOCAL grads (pre-sync): returns
+        (replicated new params, local momentum shards)."""
+        s = self.axis_size
+
+        def leaf(p, m, g):
+            chunk = self._chunk(p.size)
+            pad = s * chunk - p.size
+            g2d = jnp.pad(g.ravel(), (0, pad)).reshape(s, chunk)
+            # reduce-scatter the SUM, then divide: each device now holds
+            # only its chunk of the mean gradient.
+            g_mine = (
+                lax.psum_scatter(g2d, self.axis_name, scatter_dimension=0) / s
+            )
+            p2d = jnp.pad(p.ravel(), (0, pad)).reshape(s, chunk)
+            p_mine = lax.dynamic_index_in_dim(
+                p2d, lax.axis_index(self.axis_name), 0, keepdims=False
+            )
+            m_mine = m.reshape(chunk)
+            # torch-SGD semantics (train/state.py): decay folds into the
+            # gradient BEFORE the momentum trace.
+            g_eff = g_mine + self.weight_decay * p_mine
+            m_new = self.momentum * m_mine + g_eff
+            delta_mine = -self.learning_rate * m_new
+            delta = lax.all_gather(delta_mine, self.axis_name, axis=0)
+            delta_flat = delta.reshape(s * chunk)[: p.size]
+            return p + delta_flat.reshape(p.shape), m_new.reshape(1, chunk)
+
+        out = jax.tree.map(leaf, params, momenta, grads)
+        new_params = jax.tree.map(lambda _, o: o[0], params, out)
+        new_momenta = jax.tree.map(lambda _, o: o[1], params, out)
+        return new_params, new_momenta

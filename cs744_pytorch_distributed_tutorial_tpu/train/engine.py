@@ -93,7 +93,23 @@ class Trainer:
         self.model = get_model(
             cfg.model, num_classes=cfg.num_classes, dtype=resolve_dtype(cfg.compute_dtype)
         )
-        if cfg.fused_optimizer:
+        self._zero1 = cfg.sync == "zero1"
+        if self._zero1 and cfg.fused_optimizer:
+            raise ValueError(
+                "sync='zero1' shards the optimizer state and supplies its own "
+                "update; it cannot combine with fused_optimizer"
+            )
+        if self._zero1:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import Zero1SGD
+
+            self.tx = Zero1SGD(
+                cfg.learning_rate,
+                cfg.momentum,
+                cfg.weight_decay,
+                DATA_AXIS,
+                self.axis_size,
+            )
+        elif cfg.fused_optimizer:
             from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
 
             platforms = {d.platform for d in self.mesh.devices.flat}
@@ -121,8 +137,13 @@ class Trainer:
 
     # ------------------------------------------------------------------ build
     def _state_specs(self) -> TrainState:
+        # zero1 shards the momentum chunks (leading [axis_size] dim) over
+        # the data axis; every other strategy replicates the opt state.
         return TrainState(
-            step=P(), params=P(), batch_stats=P(DATA_AXIS), opt_state=P()
+            step=P(),
+            params=P(),
+            batch_stats=P(DATA_AXIS),
+            opt_state=P(DATA_AXIS) if self._zero1 else P(),
         )
 
     def _build_steps(self) -> None:
@@ -188,25 +209,29 @@ class Trainer:
                 grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
                 loss = lax.pmean(local_loss, DATA_AXIS)
 
+            if self._zero1 or cfg.fused_optimizer:
+                # Under zero1 the grads are still LOCAL here: Zero1SGD
+                # fuses the averaging (reduce-scatter) into its sharded
+                # update and returns replicated params + the local
+                # momentum chunk.
+                new_params, new_opt = tx.apply(state.params, state.opt_state, grads)
+            else:
+                updates, new_opt = tx.update(grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
             if self.sync_monitor is not None:
                 from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
                     tree_checksum,
                 )
 
-                # Post-sync grads must be identical on every replica; the
-                # host-side monitor verifies it (utils/debug.py).
+                # The replication invariant to verify host-side: post-sync
+                # grads everywhere — except zero1, which never materializes
+                # synced grads, so check the post-all_gather params instead.
                 jax.debug.callback(
                     self.sync_monitor.callback,
                     state.step,
                     lax.axis_index(DATA_AXIS),
-                    tree_checksum(grads),
+                    tree_checksum(new_params if self._zero1 else grads),
                 )
-
-            if cfg.fused_optimizer:
-                new_params, new_opt = tx.apply(state.params, state.opt_state, grads)
-            else:
-                updates, new_opt = tx.update(grads, state.opt_state, state.params)
-                new_params = optax.apply_updates(state.params, updates)
             metrics = {
                 "loss": loss,  # global mean for logging
                 "local_loss": local_loss[None],  # [1]/replica -> [axis_size]
@@ -299,15 +324,16 @@ class Trainer:
         return self.place_state(state)
 
     def place_state(self, state: TrainState) -> TrainState:
-        """Lay the state out on the mesh: replicated params/opt, per-replica
-        BN stats along the data axis."""
+        """Lay the state out on the mesh: replicated params, per-replica
+        BN stats along the data axis; opt state replicated — except under
+        zero1, whose momentum chunks shard over the data axis."""
         rep = replicated(self.mesh)
         dev = device_stats_sharding(self.mesh)
         return TrainState(
             step=jax.device_put(state.step, rep),
             params=jax.device_put(state.params, rep),
             batch_stats=jax.device_put(state.batch_stats, dev),
-            opt_state=jax.device_put(state.opt_state, rep),
+            opt_state=jax.device_put(state.opt_state, dev if self._zero1 else rep),
         )
 
     # ------------------------------------------------------------------ loops
