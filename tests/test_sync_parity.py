@@ -17,7 +17,7 @@ from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
 from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
 from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
-STRATEGIES = ["allreduce", "gather_scatter", "p2p_star", "ring", "auto"]
+STRATEGIES = ["allreduce", "gather_scatter", "p2p_star", "ring", "auto", "zero1"]
 
 
 def _one_step_params(strategy, mesh, batch):
