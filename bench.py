@@ -20,10 +20,18 @@ import jax
 import numpy as np
 
 # Round-1 measured value on one TPU v5 lite chip (bf16, global batch 1024,
-# sync='auto'). Later rounds benchmark against this.
+# sync='auto'). Later rounds benchmark against this. NOTE: the scored run
+# now uses GLOBAL_BATCH=4096 (below), so ~4% of vs_baseline comes from
+# that operating-point change, not code — at the baseline's batch 1024
+# this tree measures ~32.2k sps (vs_baseline ~1.49).
 ROUND1_BASELINE_SPS = 21_700.0
 
-GLOBAL_BATCH = 1024
+# Batch 4096: measured sweep (512/1024/2048/4096/6144) shows per-chip
+# throughput rising ~4% from 1024 to 4096 and flat beyond — the step is
+# HBM-bandwidth-bound (XLA cost analysis: ~2.9 GF and ~16.4 KB accessed
+# per sample fwd+bwd), so larger batches only amortize fixed overheads.
+# 8192 exceeds the tunnel's compile transfer limit.
+GLOBAL_BATCH = 4096
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 
