@@ -56,7 +56,7 @@ class TrainConfig:
     seed: int = 5000
 
     # Parallelism
-    sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1
+    sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp
     num_devices: int | None = None  # None = all visible devices
     mesh_axes: dict[str, int] | None = None  # overrides num_devices; e.g. {"data": 4}
 

@@ -69,6 +69,9 @@ def _ring(g: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
 # ``zero1`` is identity HERE because its reduce-scatter is fused into the
 # sharded-optimizer update (parallel/zero.py) — grads leave the loss
 # local and the averaging happens chunk-wise inside ``Zero1SGD.apply``.
+# ``fsdp`` likewise: its reduce-scatter is the AD transpose of the
+# parameter all_gather (parallel/zero.py FsdpSGD), so no grad-sync pass
+# exists to plug in.
 SYNC_STRATEGIES: dict[str, SyncFn] = {
     "none": _none,
     "allreduce": _allreduce,
@@ -77,12 +80,13 @@ SYNC_STRATEGIES: dict[str, SyncFn] = {
     "ring": _ring,
     "auto": _allreduce,
     "zero1": _none,
+    "fsdp": _none,
 }
 
 #: Strategies whose outputs the VMA replication checker cannot statically
 #: prove replicated (axis_index-routed selects; ``all_gather`` outputs),
 #: so the enclosing ``shard_map`` needs ``check_vma=False``.
-UNCHECKED_REPLICATION = {"p2p_star", "ring", "gather_scatter", "zero1"}
+UNCHECKED_REPLICATION = {"p2p_star", "ring", "gather_scatter", "zero1", "fsdp"}
 
 
 def get_sync(name: str) -> SyncFn:

@@ -25,6 +25,8 @@ param sharding would be ZeRO-3/FSDP).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -63,6 +65,14 @@ class Zero1SGD:
             params,
         )
 
+    def _sgd_chunk_update(self, p_mine, m_mine, g_mine):
+        """torch-SGD rule on this device's flat chunk (train/state.py):
+        decay folds into the gradient BEFORE the momentum trace. Returns
+        (new_momentum, param_delta)."""
+        g_eff = g_mine + self.weight_decay * p_mine
+        m_new = self.momentum * m_mine + g_eff
+        return m_new, -self.learning_rate * m_new
+
     def apply(self, params, momenta, grads):
         """One ZeRO-1 step on local LOCAL grads (pre-sync): returns
         (replicated new params, local momentum shards)."""
@@ -82,11 +92,7 @@ class Zero1SGD:
                 p2d, lax.axis_index(self.axis_name), 0, keepdims=False
             )
             m_mine = m.reshape(chunk)
-            # torch-SGD semantics (train/state.py): decay folds into the
-            # gradient BEFORE the momentum trace.
-            g_eff = g_mine + self.weight_decay * p_mine
-            m_new = self.momentum * m_mine + g_eff
-            delta_mine = -self.learning_rate * m_new
+            m_new, delta_mine = self._sgd_chunk_update(p_mine, m_mine, g_mine)
             delta = lax.all_gather(delta_mine, self.axis_name, axis=0)
             delta_flat = delta.reshape(s * chunk)[: p.size]
             return p + delta_flat.reshape(p.shape), m_new.reshape(1, chunk)
@@ -95,3 +101,75 @@ class Zero1SGD:
         new_params = jax.tree.map(lambda _, o: o[0], params, out)
         new_momenta = jax.tree.map(lambda _, o: o[1], params, out)
         return new_params, new_momenta
+
+
+class FsdpSGD(Zero1SGD):
+    """ZeRO-3/FSDP: params AND optimizer state sharded over the data axis.
+
+    Extends ``Zero1SGD``'s layout to the parameters themselves: each
+    device persists only a ``[1, chunk]`` flat shard per leaf. The train
+    step calls ``gather_params`` to materialize full parameters just-in-
+    time (one ``all_gather`` per leaf — the FSDP unshard), runs
+    forward/backward on them, and updates the local param+momentum
+    shards. Persistent per-device memory for params+momentum is
+    O(2 * params / axis_size); the full weights exist only transiently
+    inside the step (XLA frees them after their last use).
+
+    The gradient reduce-scatter is not written anywhere: differentiating
+    *through* ``gather_params`` makes the AD transpose of ``all_gather``
+    — which IS ``psum_scatter`` — deliver gradients already summed over
+    the axis and scattered to this device's chunk. ``apply`` only divides
+    by ``axis_size`` to turn the sum into the mean.
+
+    Communication per step and leaf: one all_gather (params) + one
+    reduce-scatter (grad cotangents) — the same total bytes as one
+    allreduce, which is why FSDP's throughput tracks plain DP until
+    params stop fitting.
+
+    Inherits hyperparameters, chunk math, momentum ``init`` and the
+    torch-SGD chunk rule from ``Zero1SGD``; ``init`` runs on host with the
+    GLOBAL param tree (shard the params themselves with ``shard_params``),
+    and the trainer remembers the original shapes for ``gather_params``.
+    """
+
+    def shard_params(self, params):
+        """Host-side: GLOBAL param tree -> ``[axis_size, chunk]`` flat
+        shards (zero-padded)."""
+        s = self.axis_size
+
+        def leaf(p):
+            chunk = self._chunk(p.size)
+            return jnp.pad(p.ravel(), (0, s * chunk - p.size)).reshape(s, chunk)
+
+        return jax.tree.map(leaf, params)
+
+    def gather_params(self, shards, shape_tree):
+        """Inside ``shard_map``: local ``[1, chunk]`` shards -> full
+        params (the FSDP unshard). ``shape_tree`` leaves carry ``.shape``
+        (e.g. the ``jax.eval_shape`` of host init)."""
+
+        def leaf(sh, sds):
+            full = lax.all_gather(sh.reshape(-1), self.axis_name, axis=0)
+            return full.reshape(-1)[: math.prod(sds.shape)].reshape(sds.shape)
+
+        return jax.tree.map(leaf, shards, shape_tree)
+
+    def apply(self, param_shards, momenta, grad_chunks):
+        """One FSDP step from CHUNKED grad sums (the ``[1, chunk]``
+        cotangents of ``gather_params``'s inputs — already psum_scattered
+        by the all_gather transpose): divide into means and apply the
+        torch-SGD rule to the local shards."""
+        s = self.axis_size
+
+        def leaf(psh, m, g):
+            chunk = psh.shape[-1]
+            g_mine = g.reshape(chunk) / s
+            p_mine = psh.reshape(chunk)
+            m_mine = m.reshape(chunk)
+            m_new, delta = self._sgd_chunk_update(p_mine, m_mine, g_mine)
+            return (p_mine + delta).reshape(1, chunk), m_new.reshape(1, chunk)
+
+        out = jax.tree.map(leaf, param_shards, momenta, grad_chunks)
+        new_shards = jax.tree.map(lambda _, o: o[0], param_shards, out)
+        new_momenta = jax.tree.map(lambda _, o: o[1], param_shards, out)
+        return new_shards, new_momenta

@@ -94,15 +94,20 @@ class Trainer:
             cfg.model, num_classes=cfg.num_classes, dtype=resolve_dtype(cfg.compute_dtype)
         )
         self._zero1 = cfg.sync == "zero1"
-        if self._zero1 and cfg.fused_optimizer:
+        self._fsdp = cfg.sync == "fsdp"
+        if (self._zero1 or self._fsdp) and cfg.fused_optimizer:
             raise ValueError(
-                "sync='zero1' shards the optimizer state and supplies its own "
-                "update; it cannot combine with fused_optimizer"
+                f"sync={cfg.sync!r} shards the optimizer state and supplies its "
+                "own update; it cannot combine with fused_optimizer"
             )
-        if self._zero1:
-            from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import Zero1SGD
+        if self._zero1 or self._fsdp:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+                FsdpSGD,
+                Zero1SGD,
+            )
 
-            self.tx = Zero1SGD(
+            cls = FsdpSGD if self._fsdp else Zero1SGD
+            self.tx = cls(
                 cfg.learning_rate,
                 cfg.momentum,
                 cfg.weight_decay,
@@ -127,6 +132,14 @@ class Trainer:
         self._sync_fn = get_sync(cfg.sync)
         self._check_vma = cfg.sync not in UNCHECKED_REPLICATION
         self.sync_monitor = None
+        if cfg.debug_sync_check and self._fsdp:
+            raise ValueError(
+                "debug_sync_check is meaningless under sync='fsdp': params are "
+                "legitimately per-device shards and the only replicated values "
+                "are all_gather outputs, equal by construction — the divergence "
+                "monitor could never fire. Check replication under zero1 or a "
+                "replicated strategy instead."
+            )
         if cfg.debug_sync_check:
             from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
                 DivergenceMonitor,
@@ -137,13 +150,16 @@ class Trainer:
 
     # ------------------------------------------------------------------ build
     def _state_specs(self) -> TrainState:
-        # zero1 shards the momentum chunks (leading [axis_size] dim) over
-        # the data axis; every other strategy replicates the opt state.
+        # zero1/fsdp shard their [axis_size, chunk] momentum leaves over
+        # the data axis; fsdp shards the params the same way (each device
+        # persists only its flat chunk — the ZeRO-3 layout). Every other
+        # strategy replicates both.
+        sharded = self._zero1 or self._fsdp
         return TrainState(
             step=P(),
-            params=P(),
+            params=P(DATA_AXIS) if self._fsdp else P(),
             batch_stats=P(DATA_AXIS),
-            opt_state=P(DATA_AXIS) if self._zero1 else P(),
+            opt_state=P(DATA_AXIS) if sharded else P(),
         )
 
     def _build_steps(self) -> None:
@@ -168,6 +184,16 @@ class Trainer:
         #    loop), then the strategy's explicit collectives average them.
         framework_inserted_sync = cfg.sync in ("auto", "none")
 
+        # fsdp needs the ORIGINAL param shapes to unshard its flat chunks
+        # (zero.py FsdpSGD.gather_params); abstract init gives them without
+        # materializing a full replica.
+        param_shapes = None
+        if self._fsdp:
+            sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+            param_shapes = jax.eval_shape(
+                lambda: model.init(jax.random.key(0), sample, train=False)
+            )["params"]
+
         def local_train_step(state: TrainState, images, labels, base_key):
             # Per-device, per-step augmentation randomness: fold the run key
             # with the step and the replica index (the DistributedSampler
@@ -190,7 +216,16 @@ class Trainer:
                 ).mean()
                 return loss, mutated["batch_stats"]
 
-            if framework_inserted_sync:
+            if self._fsdp:
+                # Differentiate THROUGH the all_gather unshard: grads come
+                # out as [1, chunk] cotangents, already reduce-scattered by
+                # the all_gather transpose (zero.py FsdpSGD docstring).
+                (local_loss, new_stats), grads = jax.value_and_grad(
+                    lambda sh: local_loss_fn(tx.gather_params(sh, param_shapes)),
+                    has_aux=True,
+                )(state.params)
+                loss = lax.pmean(local_loss, DATA_AXIS)
+            elif framework_inserted_sync:
 
                 def global_loss_fn(params):
                     local, new_stats = local_loss_fn(params)
@@ -209,11 +244,12 @@ class Trainer:
                 grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
                 loss = lax.pmean(local_loss, DATA_AXIS)
 
-            if self._zero1 or cfg.fused_optimizer:
+            if self._zero1 or self._fsdp or cfg.fused_optimizer:
                 # Under zero1 the grads are still LOCAL here: Zero1SGD
                 # fuses the averaging (reduce-scatter) into its sharded
                 # update and returns replicated params + the local
-                # momentum chunk.
+                # momentum chunk. Under fsdp grads are the already-
+                # scattered [1, chunk] sums and the update stays chunk-wise.
                 new_params, new_opt = tx.apply(state.params, state.opt_state, grads)
             else:
                 updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -226,6 +262,8 @@ class Trainer:
                 # The replication invariant to verify host-side: post-sync
                 # grads everywhere — except zero1, which never materializes
                 # synced grads, so check the post-all_gather params instead.
+                # (fsdp is rejected at construction: it has no replicated
+                # state whose divergence the monitor could catch.)
                 jax.debug.callback(
                     self.sync_monitor.callback,
                     state.step,
@@ -293,8 +331,13 @@ class Trainer:
             (1.0 real / 0.0 padding) keeps batch shapes static on any
             mesh while counting each test example exactly once."""
             local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
+            params = (
+                tx.gather_params(state.params, param_shapes)
+                if self._fsdp
+                else state.params
+            )
             logits = model.apply(
-                {"params": state.params, "batch_stats": local_stats},
+                {"params": params, "batch_stats": local_stats},
                 eval_batch(images),
                 train=False,
             )
@@ -321,19 +364,25 @@ class Trainer:
         rng = jax.random.key(cfg.seed if seed is None else seed)
         sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
         state = init_state(self.model, self.tx, rng, sample, self.axis_size)
+        if self._fsdp:
+            # The full replica existed only for initialization; persist the
+            # [axis_size, chunk] flat shards (ZeRO-3's memory contract).
+            state = state.replace(params=self.tx.shard_params(state.params))
         return self.place_state(state)
 
     def place_state(self, state: TrainState) -> TrainState:
         """Lay the state out on the mesh: replicated params, per-replica
         BN stats along the data axis; opt state replicated — except under
-        zero1, whose momentum chunks shard over the data axis."""
+        zero1, whose momentum chunks shard over the data axis, and fsdp,
+        where params AND momentum live as data-axis-sharded flat chunks."""
         rep = replicated(self.mesh)
         dev = device_stats_sharding(self.mesh)
+        sharded_opt = self._zero1 or self._fsdp
         return TrainState(
             step=jax.device_put(state.step, rep),
-            params=jax.device_put(state.params, rep),
+            params=jax.device_put(state.params, dev if self._fsdp else rep),
             batch_stats=jax.device_put(state.batch_stats, dev),
-            opt_state=jax.device_put(state.opt_state, dev if self._zero1 else rep),
+            opt_state=jax.device_put(state.opt_state, dev if sharded_opt else rep),
         )
 
     # ------------------------------------------------------------------ loops

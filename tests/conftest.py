@@ -41,3 +41,40 @@ def mesh8():
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
 
     return make_mesh({"data": 8})
+
+
+# Shared tiny-CNN harness for the sharded-optimizer parity suites
+# (test_zero1.py, test_fsdp.py): same config, same synthetic batches.
+TINY_DP4_CFG = dict(
+    model="tiny_cnn",
+    num_devices=4,
+    global_batch_size=32,
+    synthetic_data=True,
+    synthetic_train_size=128,
+    synthetic_test_size=64,
+)
+
+
+def run_tiny_dp4_steps(sync: str, mesh, steps: int = 4):
+    """Train ``steps`` repeats of one fixed synthetic batch under strategy
+    ``sync``; returns (losses, trainer, final_state)."""
+    import jax
+
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    cfg = TrainConfig(**TINY_DP4_CFG, sync=sync)
+    tr = Trainer(cfg, mesh=mesh)
+    state = tr.init()
+    ds = synthetic_cifar10(TINY_DP4_CFG["global_batch_size"], 8, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    key = jax.random.key(cfg.seed)
+    losses = []
+    for _ in range(steps):
+        state, m = tr.train_step(state, x, y, key)
+        losses.append(float(m["loss"]))
+    return losses, tr, state

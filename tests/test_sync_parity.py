@@ -17,7 +17,15 @@ from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
 from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
 from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
-STRATEGIES = ["allreduce", "gather_scatter", "p2p_star", "ring", "auto", "zero1"]
+STRATEGIES = [
+    "allreduce",
+    "gather_scatter",
+    "p2p_star",
+    "ring",
+    "auto",
+    "zero1",
+    "fsdp",
+]
 
 
 def _one_step_params(strategy, mesh, batch):
@@ -36,8 +44,25 @@ def _one_step_params(strategy, mesh, batch):
     gx, gy = shard_global_batch(mesh, x, y)
     key = jax.random.key(cfg.seed)
     new_state, metrics = tr.train_step(state, gx, gy, key)
+    params = jax.device_get(new_state.params)
+    if strategy == "fsdp":
+        # fsdp persists [axis_size, chunk] flat shards; unshard host-side
+        # to the original shapes so the matrix compares like with like.
+        import jax.numpy as jnp
+
+        sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+        shapes = jax.eval_shape(
+            lambda: tr.model.init(jax.random.key(0), sample, train=False)
+        )["params"]
+        params = jax.tree.map(
+            lambda sh, ref: np.asarray(sh).reshape(-1)[
+                : int(np.prod(ref.shape))
+            ].reshape(ref.shape),
+            params,
+            shapes,
+        )
     return (
-        jax.tree.map(np.asarray, jax.device_get(new_state.params)),
+        jax.tree.map(np.asarray, params),
         float(metrics["loss"]),
     )
 

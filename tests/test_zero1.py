@@ -10,55 +10,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import TINY_DP4_CFG, run_tiny_dp4_steps
 
 from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
-from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
-from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
-from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import shard_global_batch
 from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
-
-CFG = dict(
-    model="tiny_cnn",
-    num_devices=4,
-    global_batch_size=32,
-    synthetic_data=True,
-    synthetic_train_size=128,
-    synthetic_test_size=64,
-)
-
-
-def _run_steps(sync: str, mesh, steps: int = 4):
-    cfg = TrainConfig(**CFG, sync=sync)
-    tr = Trainer(cfg, mesh=mesh)
-    state = tr.init()
-    ds = synthetic_cifar10(CFG["global_batch_size"], 8, seed=0)
-    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
-    key = jax.random.key(cfg.seed)
-    losses = []
-    for _ in range(steps):
-        state, m = tr.train_step(state, x, y, key)
-        losses.append(float(m["loss"]))
-    return losses, jax.device_get(state.params), state
 
 
 def test_zero1_matches_allreduce(mesh4):
     """Same batches, same seed: zero1 and allreduce must trace the same
     loss curve and land on the same params (reduce_scatter+all_gather is
     allreduce, just decomposed)."""
-    l_ar, p_ar, _ = _run_steps("allreduce", mesh4)
-    l_z, p_z, _ = _run_steps("zero1", mesh4)
+    l_ar, _, st_ar = run_tiny_dp4_steps("allreduce", mesh4)
+    l_z, _, st_z = run_tiny_dp4_steps("zero1", mesh4)
     np.testing.assert_allclose(l_ar, l_z, rtol=1e-5)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
-        p_ar,
-        p_z,
+        jax.device_get(st_ar.params),
+        jax.device_get(st_z.params),
     )
 
 
 def test_zero1_momentum_is_sharded(mesh4):
     """Each device holds only its [1, chunk] momentum shard — the memory
     claim of ZeRO-1."""
-    _, _, state = _run_steps("zero1", mesh4, steps=1)
+    _, _, state = run_tiny_dp4_steps("zero1", mesh4, steps=1)
     leaves = jax.tree.leaves(state.opt_state)
     assert leaves, "zero1 opt state is empty"
     for leaf in leaves:
@@ -72,9 +47,9 @@ def test_zero1_momentum_is_sharded(mesh4):
 def test_zero1_uneven_param_sizes(mesh4):
     """Padding path: param sizes not divisible by axis_size still round-trip
     exactly (biases of size 10, BN scales of odd sizes, etc.)."""
-    _, p_z, _ = _run_steps("zero1", mesh4, steps=2)
+    _, _, state = run_tiny_dp4_steps("zero1", mesh4, steps=2)
     # the head bias has 10 elements (not divisible by 4) — finite + updated
-    bias = p_z["Dense_0"]["bias"]
+    bias = jax.device_get(state.params)["Dense_0"]["bias"]
     assert bias.shape == (10,)
     assert np.isfinite(bias).all()
     assert np.abs(bias).max() > 0
@@ -82,4 +57,7 @@ def test_zero1_uneven_param_sizes(mesh4):
 
 def test_zero1_rejects_fused_optimizer(mesh4):
     with pytest.raises(ValueError, match="zero1"):
-        Trainer(TrainConfig(**CFG, sync="zero1", fused_optimizer=True), mesh=mesh4)
+        Trainer(
+            TrainConfig(**TINY_DP4_CFG, sync="zero1", fused_optimizer=True),
+            mesh=mesh4,
+        )
