@@ -56,6 +56,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-sync-check", action="store_true", default=None,
                    help="stream per-replica grad checksums and fail on divergence")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=None,
+                   help="checkpoint every N steps (0 = only at end)")
+    p.add_argument("--step-timeout-s", type=float, default=None,
+                   help="arm a hang watchdog per training step (utils/failure.py)")
+    p.add_argument("--hang-action", choices=["log", "abort"], default=None,
+                   help="watchdog action after reporting a hang: 'log' "
+                        "(observe) or 'abort' (exit so a supervisor restarts "
+                        "the job from the newest checkpoint)")
+    p.add_argument("--no-halt-on-nonfinite", dest="halt_on_nonfinite",
+                   action="store_false", default=None,
+                   help="keep training through NaN/inf losses instead of "
+                        "raising NonFiniteLossError")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="restart from the newest checkpoint on detected "
+                        "training failures (needs --checkpoint-dir)")
     # init_process mirror (master/part2a/part2a.py:80-85)
     p.add_argument("--coordinator", dest="coordinator_address", default=None,
                    help="coordinator address host:port (the --master-ip analog)")
@@ -91,6 +106,10 @@ _ARG_TO_FIELD = {
     "prefetch_depth": "prefetch_depth",
     "debug_sync_check": "debug_sync_check",
     "checkpoint_dir": "checkpoint_dir",
+    "checkpoint_every": "checkpoint_every",
+    "step_timeout_s": "step_timeout_s",
+    "hang_action": "hang_action",
+    "halt_on_nonfinite": "halt_on_nonfinite",
     "coordinator_address": "coordinator_address",
     "num_processes": "num_processes",
     "process_id": "process_id",
@@ -125,7 +144,18 @@ def main(argv: list[str] | None = None) -> int:
     from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
     trainer = Trainer(cfg)
-    state, history = trainer.fit()
+    if args.max_restarts > 0:
+        from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+            run_with_recovery,
+        )
+
+        state, history, restarts = run_with_recovery(
+            trainer, max_restarts=args.max_restarts
+        )
+        if restarts:
+            print(f"recovered after {restarts} restart(s)")
+    else:
+        state, history = trainer.fit()
 
     if args.json and history["eval"]:
         last = history["eval"][-1]

@@ -92,6 +92,21 @@ class TrainConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end when checkpoint_dir set
 
+    # Failure detection (utils/failure.py — the reference's Gloo run just
+    # hangs or dies, SURVEY §5.3). halt_on_nonfinite raises
+    # NonFiniteLossError when a fetched loss is NaN/inf (checked at
+    # logging granularity — zero extra transfers); step_timeout_s arms a
+    # host-side watchdog that logs + dumps stacks if a step hangs (the
+    # first executed batch is exempt: it blocks on XLA compilation, which
+    # the timing window likewise excludes). hang_action picks what the
+    # watchdog does after reporting: "log" (observe only) or "abort"
+    # (os._exit so a supervisor — the coordination service, k8s, a shell
+    # loop — restarts the process; a wedged device fetch cannot be
+    # unblocked from within the process).
+    halt_on_nonfinite: bool = True
+    step_timeout_s: float | None = None
+    hang_action: str = "log"  # "log" | "abort"
+
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
 

@@ -104,12 +104,18 @@ class BatchLoader:
         )
 
     # ------------------------------------------------------------------ epochs
-    def epoch(self, epoch: int) -> Iterator[tuple[jax.Array, jax.Array]]:
-        """Full-size training batches (wrap-padded unless ``drop_last``)."""
+    def epoch(
+        self, epoch: int, start: int = 0
+    ) -> Iterator[tuple[jax.Array, jax.Array]]:
+        """Full-size training batches (wrap-padded unless ``drop_last``).
+
+        ``start`` skips the first batches of the epoch's deterministic
+        plan WITHOUT assembling or transferring them — index arithmetic
+        only (the mid-epoch checkpoint-resume path, train/engine.py)."""
         order = epoch_permutation(self.num_examples, self.seed, epoch, self.shuffle)
         bsz = self.global_batch_size
         order = wrap_pad(order, len(self) * bsz)
-        for b in range(len(self)):
+        for b in range(start, len(self)):
             idx = order[b * bsz : (b + 1) * bsz]
             yield self._put_global(
                 gather_rows(self.images, idx), gather_rows(self.labels, idx)

@@ -131,6 +131,10 @@ class Trainer:
         self.log = get_logger()
         self._sync_fn = get_sync(cfg.sync)
         self._check_vma = cfg.sync not in UNCHECKED_REPLICATION
+        if cfg.hang_action not in ("log", "abort"):
+            raise ValueError(
+                f"unknown hang_action {cfg.hang_action!r}; choose 'log' or 'abort'"
+            )
         self.sync_monitor = None
         if cfg.debug_sync_check and self._fsdp:
             raise ValueError(
@@ -449,60 +453,199 @@ class Trainer:
                     start_epoch,
                 )
 
-        for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
-            timer.start()
-            for batch_idx, (x, y) in enumerate(
-                prefetch(train_loader.epoch(epoch), cfg.prefetch_depth)
-            ):
-                state, metrics = self.train_step(state, x, y, base_key)
-                # Fetch the loss value only while timing or logging needs
-                # it — otherwise leave dispatch fully async so the host
-                # stages batch N+1 while the device runs batch N. The fetch
-                # must be a device_get (float()), not block_until_ready:
-                # the latter is not a reliable completion fence on this
-                # environment's tunneled TPU backend (see bench.py).
-                timing_active = timer.steps_recorded <= cfg.timing_batches[1]
-                should_log = batch_idx % cfg.log_every == 0
-                if timing_active or should_log:
-                    loss = float(metrics["loss"])
-                if timing_active:
-                    timer.tick()
-                    if timer.steps_recorded == cfg.timing_batches[1] + 1:
-                        avg = timer.window_average()
-                        history["avg_batch_time"] = avg
-                        self.log.info("average time:  %f", avg)
-                if should_log:
-                    history["train_loss"].append((epoch, batch_idx, loss))
-                    self.log.info("%d loss:  %f", batch_idx, loss)
-                steps_done += 1
-                if ckpt and cfg.checkpoint_every and steps_done % cfg.checkpoint_every == 0:
-                    ckpt.save(state)
-            if self.sync_monitor is not None:
-                # Epoch boundary: fence in-flight debug callbacks and fail
-                # loudly if any replica drifted (utils/debug.py).
-                self.sync_monitor.assert_in_sync()
-            eval_metrics = self.evaluate(state, test_loader)
-            history["eval"].append(eval_metrics)
-            self.log.info(
-                "Test set: Average loss: %.4f, Accuracy: %d/%d (%.0f%%)",
-                eval_metrics["avg_loss"],
-                eval_metrics["correct"],
-                eval_metrics["count"],
-                100.0 * eval_metrics["accuracy"],
+        watchdog = None
+        if cfg.step_timeout_s:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+                StepWatchdog,
             )
-        if ckpt is not None:
-            ckpt.save(state, force=True)
+
+            on_hang = None
+            if cfg.hang_action == "abort":
+                import os
+
+                # A wedged device fetch can't be unblocked from inside the
+                # process; exit so the supervisor (coordination service,
+                # k8s, a shell loop around the CLI) restarts the job, which
+                # resumes from the newest checkpoint.
+                def on_hang(elapsed_s: float) -> None:
+                    os._exit(13)
+
+            watchdog = StepWatchdog(cfg.step_timeout_s, on_hang=on_hang)
+        if cfg.halt_on_nonfinite:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+                NonFiniteLossError,
+            )
+
+        # Mid-epoch resume: the restored state already contains the first
+        # ``steps_done % steps_per_epoch`` batches of this epoch — replaying
+        # them would double-apply updates, so skip forward through the
+        # epoch's deterministic batch plan (loader order is a pure function
+        # of (seed, epoch)) to the recorded step. The loader's ``start``
+        # offsets the index plan itself, so skipped batches are never
+        # assembled or transferred — index arithmetic only.
+        resume_skip = steps_done % steps_per_epoch if steps_per_epoch else 0
+
+        def guarded_save(save_state, *, force: bool = False) -> None:
+            """Checkpoint under a widened watchdog window: saves block on
+            serialization + disk and legitimately outlast a step, but a
+            wedged device fetch inside the save should still be caught."""
+            if watchdog is not None:
+                watchdog.arm(cfg.step_timeout_s * 10)
+            try:
+                ckpt.save(save_state, force=force)
+            finally:
+                if watchdog is not None:
+                    watchdog.disarm()
+
+        # Divergence-safe checkpointing under halt_on_nonfinite: the loss
+        # fetched at step k is the forward pass over the params step k-1
+        # PRODUCED, so a due checkpoint is held as (step_count, state) and
+        # persisted only once the NEXT step's (or the epoch eval's) loss
+        # over those params comes back finite. Restart recovery therefore
+        # can never restore a state whose own forward pass diverged.
+        pending_ckpt: tuple[int, TrainState] | None = None
+
+        # The first executed batch blocks on XLA compilation (minutes for
+        # large models) — exempt it from the watchdog the same way the
+        # timing window excludes step 0 (utils/timing.py, SURVEY §7d).
+        compile_pending = True
+
+        try:
+            for epoch in range(
+                start_epoch, epochs if epochs is not None else cfg.epochs
+            ):
+                timer.start()
+                skip = resume_skip if epoch == start_epoch else 0
+                batch_iter = enumerate(
+                    prefetch(train_loader.epoch(epoch, start=skip), cfg.prefetch_depth),
+                    start=skip,
+                )
+                while True:
+                    # The armed window covers batch acquisition too: a
+                    # wedged chip blocks the prefetch producer's device_put
+                    # and this thread then hangs in the queue get — the
+                    # primary hang mode the watchdog exists to catch.
+                    arm_now = watchdog is not None and not compile_pending
+                    if arm_now:
+                        watchdog.arm()
+                    try:
+                        batch_idx, (x, y) = next(batch_iter)
+                    except StopIteration:
+                        if arm_now:
+                            watchdog.disarm()
+                        break
+                    state, metrics = self.train_step(state, x, y, base_key)
+                    # jit's first call traced+compiled synchronously above,
+                    # so every later iteration runs under the watchdog.
+                    compile_pending = False
+                    # Fetch the loss value only while timing or logging needs
+                    # it — otherwise leave dispatch fully async so the host
+                    # stages batch N+1 while the device runs batch N. The fetch
+                    # must be a device_get (float()), not block_until_ready:
+                    # the latter is not a reliable completion fence on this
+                    # environment's tunneled TPU backend (see bench.py).
+                    timing_active = timer.steps_recorded <= cfg.timing_batches[1]
+                    should_log = batch_idx % cfg.log_every == 0
+                    checkpoint_due = bool(
+                        ckpt
+                        and cfg.checkpoint_every
+                        and (steps_done + 1) % cfg.checkpoint_every == 0
+                    )
+                    if timing_active or should_log or pending_ckpt is not None:
+                        loss = float(metrics["loss"])
+                        if watchdog is not None:
+                            watchdog.disarm()  # the fetch is the hang point
+                        if cfg.halt_on_nonfinite and not math.isfinite(loss):
+                            raise NonFiniteLossError(steps_done, loss)
+                        if pending_ckpt is not None and steps_done == pending_ckpt[0]:
+                            # this loss is the forward pass over the pending
+                            # state's params — certified finite, persist it
+                            guarded_save(pending_ckpt[1])
+                            pending_ckpt = None
+                    elif watchdog is not None:
+                        watchdog.disarm()
+                    if timing_active:
+                        timer.tick()
+                        if timer.steps_recorded == cfg.timing_batches[1] + 1:
+                            avg = timer.window_average()
+                            history["avg_batch_time"] = avg
+                            self.log.info("average time:  %f", avg)
+                    if should_log:
+                        history["train_loss"].append((epoch, batch_idx, loss))
+                        self.log.info("%d loss:  %f", batch_idx, loss)
+                    steps_done += 1
+                    if checkpoint_due:
+                        if cfg.halt_on_nonfinite:
+                            # Copy: train_step donates its input state, so
+                            # holding the live object across the next step
+                            # would reference deleted buffers.
+                            pending_ckpt = (
+                                steps_done,
+                                jax.tree.map(jnp.copy, state),
+                            )
+                        else:
+                            guarded_save(state)
+                if self.sync_monitor is not None:
+                    # Epoch boundary: fence in-flight debug callbacks and fail
+                    # loudly if any replica drifted (utils/debug.py).
+                    self.sync_monitor.assert_in_sync()
+                eval_metrics = self.evaluate(state, test_loader, watchdog=watchdog)
+                history["eval"].append(eval_metrics)
+                self.log.info(
+                    "Test set: Average loss: %.4f, Accuracy: %d/%d (%.0f%%)",
+                    eval_metrics["avg_loss"],
+                    eval_metrics["correct"],
+                    eval_metrics["count"],
+                    100.0 * eval_metrics["accuracy"],
+                )
+                if cfg.halt_on_nonfinite and not math.isfinite(
+                    eval_metrics["avg_loss"]
+                ):
+                    raise NonFiniteLossError(steps_done, eval_metrics["avg_loss"])
+                if pending_ckpt is not None and steps_done == pending_ckpt[0]:
+                    # epoch ended right after the due step: the eval loss
+                    # just certified the pending (== current) state
+                    guarded_save(pending_ckpt[1])
+                    pending_ckpt = None
+            if ckpt is not None:
+                guarded_save(state, force=True)
+        finally:
+            if watchdog is not None:
+                watchdog.close()
         return state, history
 
-    def evaluate(self, state: TrainState, test_loader: BatchLoader) -> dict[str, float]:
+    def evaluate(
+        self, state: TrainState, test_loader: BatchLoader, watchdog=None
+    ) -> dict[str, float]:
+        """Eval over the test set; ``watchdog`` (utils/failure.py), when
+        supplied, arms around each batch's dispatch+fetch so a wedged
+        device fetch during eval is still detected. The first eval batch
+        is exempt — it blocks on eval_step's XLA compilation."""
         total_loss, total_correct, total_count = 0.0, 0, 0
-        for x, y, mask in prefetch(
-            test_loader.epoch_padded(0), self.cfg.prefetch_depth
-        ):
-            m = self.eval_step(state, x, y, mask)
-            total_loss += float(m["loss_sum"])
-            total_correct += int(m["correct"])
-            total_count += int(m["count"])
+        first = True
+        batch_iter = iter(
+            prefetch(test_loader.epoch_padded(0), self.cfg.prefetch_depth)
+        )
+        while True:
+            # Arm BEFORE acquisition: a wedged chip blocks the prefetch
+            # producer's device_put and this thread then hangs in the
+            # queue get — same placement as the train loop.
+            arm_now = watchdog is not None and not first
+            if arm_now:
+                watchdog.arm()
+            try:
+                try:
+                    x, y, mask = next(batch_iter)
+                except StopIteration:
+                    break
+                m = self.eval_step(state, x, y, mask)
+                total_loss += float(m["loss_sum"])
+                total_correct += int(m["correct"])
+                total_count += int(m["count"])
+            finally:
+                if arm_now:
+                    watchdog.disarm()
+            first = False
         return {
             "avg_loss": total_loss / max(total_count, 1),
             "correct": total_correct,

@@ -1,8 +1,15 @@
-"""Utilities: logging, step timing, checkpointing, profiling, debug."""
+"""Utilities: logging, step timing, checkpointing, profiling, debug,
+failure detection/recovery."""
 
 from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
     DivergenceMonitor,
     tree_checksum,
+)
+from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+    NonFiniteLossError,
+    StepWatchdog,
+    TrainingFailure,
+    run_with_recovery,
 )
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger, rank_zero_only
 from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
@@ -10,7 +17,11 @@ from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
 __all__ = [
     "DivergenceMonitor",
     "get_logger",
+    "NonFiniteLossError",
     "rank_zero_only",
+    "run_with_recovery",
     "StepTimer",
+    "StepWatchdog",
+    "TrainingFailure",
     "tree_checksum",
 ]

@@ -1,0 +1,210 @@
+"""Failure detection and recovery — the subsystem the reference lacks.
+
+The reference has NO failure story: if any rank dies, its Gloo
+collectives hang or error with no retry and no elasticity (SURVEY §5.3);
+one latent bug — the slave's unmatched ``isend`` of eval results
+(``slave/part2b/part2b.py:67-69``) — would itself hang a stricter
+backend. The TPU-native stance: XLA collectives inside one jitted
+program can't race, but a step CAN hang (wedged chip, dead host in the
+coordination service) or diverge (non-finite loss). This module supplies
+the three pieces the reference is missing:
+
+1. ``StepWatchdog`` — host-side hang detection. The train loop arms it
+   around each step; if the step doesn't complete within the timeout the
+   watchdog fires on its own thread: logs, dumps all Python stacks
+   (``faulthandler``) so the operator sees WHERE the host is blocked
+   (usually a device transfer behind a dead collective), and invokes an
+   optional callback (in multi-host deployments: abort the process so
+   the coordination service can restart the job).
+2. ``NonFiniteLossError`` — divergence detection. ``Trainer.fit`` raises
+   it when a fetched loss is NaN/inf (checked at logging granularity, so
+   detection costs zero extra host<->device transfers).
+3. ``run_with_recovery`` — checkpoint/restart elasticity. Wraps a
+   trainer's ``fit``; on a detected failure it re-enters ``fit``, which
+   restores the newest checkpoint (``utils/checkpoint.py``) and resumes
+   from the step it recorded — up to ``max_restarts`` times.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
+
+
+class TrainingFailure(RuntimeError):
+    """Base class for detected training failures (recoverable by restart)."""
+
+
+class NonFiniteLossError(TrainingFailure):
+    """Loss came back NaN/inf — the run has diverged."""
+
+    def __init__(self, step: int, loss: float):
+        super().__init__(f"non-finite loss {loss!r} at step {step}")
+        self.step = step
+        self.loss = loss
+
+
+class StepWatchdog:
+    """Detect hung training steps from the host side.
+
+    Usage::
+
+        wd = StepWatchdog(timeout_s=300)
+        for batch in loader:
+            with wd.watch():
+                state, metrics = train_step(state, *batch)
+        wd.close()
+
+    If a watched section outlives ``timeout_s`` the watchdog — on its own
+    long-lived monitor thread, since the training thread is the one
+    that's stuck — logs a critical message, dumps every thread's Python
+    stack to stderr, and calls ``on_hang(elapsed_s)``. It fires at most
+    once per watched section and never interrupts the training thread
+    itself: detection, not preemption (in multi-host runs the callback
+    should abort the process and let the coordination service restart
+    the job).
+
+    One monitor thread serves the whole run (arm/disarm just move a
+    deadline under a condition variable — no per-step thread churn), and
+    once ``disarm`` returns, no fire for that section can happen: the
+    deadline check AND the report itself run under the lock, so a
+    concurrent ``disarm`` either cancels the fire or blocks until the
+    report finishes.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_hang: Callable[[float], None] | None = None,
+        dump_stacks: bool = True,
+    ):
+        self.timeout_s = timeout_s
+        self.on_hang = on_hang
+        self.dump_stacks = dump_stacks
+        self.fired = 0  # total hang detections (for tests/metrics)
+        self._log = get_logger()
+        self._cv = threading.Condition()
+        self._deadline: float | None = None  # None = disarmed
+        self._armed_timeout = timeout_s
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def arm(self, timeout_s: float | None = None) -> None:
+        """Start the countdown for one section; ``timeout_s`` overrides the
+        default for sections with a different latency envelope (e.g. a
+        checkpoint save)."""
+        with self._cv:
+            self._armed_timeout = timeout_s if timeout_s is not None else self.timeout_s
+            self._deadline = time.monotonic() + self._armed_timeout
+            self._cv.notify()
+
+    def disarm(self) -> None:
+        """The step completed in time; stop the countdown."""
+        with self._cv:
+            self._deadline = None
+            self._cv.notify()
+
+    @contextlib.contextmanager
+    def watch(self):
+        """Context manager: ``arm`` on enter, ``disarm`` on exit (also on
+        exception paths)."""
+        self.arm()
+        try:
+            yield self
+        finally:
+            self.disarm()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._deadline = None
+            self._cv.notify()
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                if self._deadline is None:
+                    self._cv.wait()
+                    continue
+                now = time.monotonic()
+                remaining = self._deadline - now
+                if remaining > 0:
+                    self._cv.wait(timeout=remaining)
+                    continue
+                # Expired while still armed: consume the deadline (fire
+                # once per section) and report WHILE HOLDING the lock, so
+                # disarm() can never return with a fire still pending.
+                elapsed = self._armed_timeout + (now - self._deadline)
+                self._deadline = None
+                self._fire(elapsed, self._armed_timeout)
+
+    def _fire(self, elapsed_s: float, timeout_s: float) -> None:
+        self.fired += 1
+        self._log.critical(
+            "watchdog: training step exceeded %.1fs (%.1fs elapsed) — host is "
+            "likely blocked on a device transfer behind a hung collective; "
+            "dumping stacks",
+            timeout_s,
+            elapsed_s,
+        )
+        if self.dump_stacks:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        if self.on_hang is not None:
+            self.on_hang(elapsed_s)
+
+
+def run_with_recovery(
+    trainer: Any,
+    *,
+    max_restarts: int = 2,
+    fit_kwargs: dict[str, Any] | None = None,
+):
+    """Run ``trainer.fit`` with checkpoint/restart recovery.
+
+    On a ``TrainingFailure`` (e.g. ``NonFiniteLossError``) the run is
+    restarted: ``fit`` restores the newest checkpoint for its
+    ``checkpoint_dir`` and resumes at the recorded step, so work since
+    the last checkpoint — including the steps that produced the
+    divergence — is replayed from known-good state. Requires
+    ``trainer.cfg.checkpoint_dir`` (without it there is nothing to
+    restart FROM, and the failure re-raises immediately).
+
+    Returns ``(state, history, restarts)``.
+    """
+    log = get_logger()
+    if not getattr(trainer.cfg, "checkpoint_dir", None):
+        raise ValueError(
+            "run_with_recovery needs cfg.checkpoint_dir: restart-based "
+            "recovery resumes from the newest checkpoint"
+        )
+    kwargs = fit_kwargs or {}
+    restarts = 0
+    while True:
+        try:
+            state, history = trainer.fit(**kwargs)
+            return state, history, restarts
+        except TrainingFailure as e:
+            restarts += 1
+            if restarts > max_restarts:
+                log.critical(
+                    "giving up after %d restarts (last failure: %s)", restarts - 1, e
+                )
+                raise
+            log.error(
+                "training failure (%s); restart %d/%d from newest checkpoint",
+                e,
+                restarts,
+                max_restarts,
+            )

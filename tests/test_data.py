@@ -175,3 +175,18 @@ def test_batch_loader_epoch_determinism(mesh4):
     a = [np.asarray(x)[0, 0, 0, 0] for x, _ in loader.epoch(0)]
     b = [np.asarray(x)[0, 0, 0, 0] for x, _ in loader.epoch(0)]
     assert a == b
+
+
+def test_batch_loader_epoch_start_offsets_plan(mesh4):
+    """epoch(e, start=k) yields exactly the tail of epoch(e)'s plan — the
+    mid-epoch resume contract (no batches assembled for the skipped head)."""
+    ds = synthetic_cifar10(64, 16, seed=0)
+    loader = BatchLoader(
+        ds.train_images, ds.train_labels, 16, mesh=mesh4, shuffle=True, seed=7
+    )
+    full = [(np.asarray(x), np.asarray(y)) for x, y in loader.epoch(3)]
+    tail = [(np.asarray(x), np.asarray(y)) for x, y in loader.epoch(3, start=2)]
+    assert len(tail) == len(full) - 2
+    for (fx, fy), (tx, ty) in zip(full[2:], tail):
+        np.testing.assert_array_equal(fx, tx)
+        np.testing.assert_array_equal(fy, ty)

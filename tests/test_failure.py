@@ -1,0 +1,160 @@
+"""Failure detection + recovery (utils/failure.py, SURVEY §5.3).
+
+The reference has no failure story — a dead rank hangs its Gloo
+collectives with no retry. These tests exercise the three replacement
+pieces with injected faults: the hang watchdog, non-finite-loss
+detection inside ``Trainer.fit``, and the checkpoint/restart recovery
+loop.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import TINY_DP4_CFG
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+    NonFiniteLossError,
+    StepWatchdog,
+    TrainingFailure,
+    run_with_recovery,
+)
+
+
+def test_watchdog_fires_on_hang():
+    hangs = []
+    wd = StepWatchdog(timeout_s=0.15, on_hang=hangs.append, dump_stacks=False)
+    wd.arm()
+    time.sleep(0.5)  # the "hung step"
+    wd.disarm()
+    wd.close()
+    assert wd.fired == 1
+    assert len(hangs) == 1 and hangs[0] >= 0.15  # actual elapsed time
+
+
+def test_watchdog_quiet_on_fast_steps():
+    wd = StepWatchdog(timeout_s=0.3, dump_stacks=False)
+    for _ in range(5):
+        with wd.watch():
+            time.sleep(0.01)
+    time.sleep(0.5)  # well past the timeout — but every section disarmed
+    wd.close()
+    assert wd.fired == 0
+
+
+def _nan_injecting(trainer, fail_at_call: int, transient: bool):
+    """Wrap trainer.train_step to return a NaN loss. ``transient``: NaN
+    exactly once, on the Nth call (a flaky-chip analog). Persistent: NaN
+    on every call from the Nth on (deterministic divergence — replays
+    identically after each restart)."""
+    orig = trainer.train_step
+    calls = {"n": 0, "injected": False}
+
+    def step(*args):
+        state, metrics = orig(*args)
+        calls["n"] += 1
+        fire = (
+            calls["n"] == fail_at_call and not calls["injected"]
+            if transient
+            else calls["n"] >= fail_at_call
+        )
+        if fire:
+            calls["injected"] = True
+            metrics = dict(metrics, loss=jnp.float32(float("nan")))
+        return state, metrics
+
+    trainer.train_step = step
+    return calls
+
+
+def test_fit_raises_on_nonfinite_loss(mesh4):
+    cfg = TrainConfig(**TINY_DP4_CFG, sync="allreduce", log_every=1)
+    tr = Trainer(cfg, mesh=mesh4)
+    _nan_injecting(tr, fail_at_call=2, transient=False)
+    with pytest.raises(NonFiniteLossError) as ei:
+        tr.fit()
+    assert ei.value.step == 1  # 0-indexed: the second step diverged
+
+
+def test_run_with_recovery_restarts_then_succeeds(mesh4, tmp_path):
+    """A transient fault (NaN once, clean on replay) recovers with exactly
+    one restart, resuming MID-epoch from the newest checkpoint — already-
+    applied batches are skipped, not double-applied, so the recovered run
+    lands on the identical parameters of an uninterrupted run."""
+    import jax
+
+    base = dict(**TINY_DP4_CFG, sync="allreduce", log_every=1)
+    clean = Trainer(TrainConfig(**base), mesh=mesh4)
+    clean_state, _ = clean.fit()
+    clean_params = jax.device_get(clean_state.params)
+
+    cfg = TrainConfig(
+        **base,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=1,
+    )
+    tr = Trainer(cfg, mesh=mesh4)
+    calls = _nan_injecting(tr, fail_at_call=3, transient=True)
+    state, history, restarts = run_with_recovery(tr, max_restarts=2)
+    assert restarts == 1
+    assert calls["injected"]
+    assert np.isfinite(history["eval"][-1]["avg_loss"])
+    # exact resume: step count matches the uninterrupted epoch (4 batches),
+    # and params match the clean trajectory bit-for-bit
+    assert int(jnp.asarray(state.step)) == 4  # 128/32 = 4 steps per epoch
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        clean_params,
+        jax.device_get(state.params),
+    )
+
+
+def test_run_with_recovery_gives_up_on_persistent_failure(mesh4, tmp_path):
+    """Deterministic divergence replays identically; after max_restarts the
+    failure propagates instead of looping forever."""
+    cfg = TrainConfig(
+        **TINY_DP4_CFG,
+        sync="allreduce",
+        log_every=1,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=1,
+    )
+    tr = Trainer(cfg, mesh=mesh4)
+    _nan_injecting(tr, fail_at_call=2, transient=False)
+    with pytest.raises(NonFiniteLossError):
+        run_with_recovery(tr, max_restarts=1)
+
+
+def test_run_with_recovery_requires_checkpoint_dir(mesh4):
+    tr = Trainer(TrainConfig(**TINY_DP4_CFG, sync="allreduce"), mesh=mesh4)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        run_with_recovery(tr)
+
+
+def test_training_failure_is_runtime_error():
+    assert issubclass(NonFiniteLossError, TrainingFailure)
+    assert issubclass(TrainingFailure, RuntimeError)
+
+
+def test_hang_action_validated(mesh4):
+    with pytest.raises(ValueError, match="hang_action"):
+        Trainer(
+            TrainConfig(**TINY_DP4_CFG, sync="allreduce", hang_action="explode"),
+            mesh=mesh4,
+        )
+
+
+def test_halt_on_nonfinite_can_be_disabled(mesh4):
+    """With halt_on_nonfinite=False (CLI --no-halt-on-nonfinite) the run
+    observes the NaN and keeps training — the reference's behavior."""
+    cfg = TrainConfig(
+        **TINY_DP4_CFG, sync="allreduce", log_every=1, halt_on_nonfinite=False
+    )
+    tr = Trainer(cfg, mesh=mesh4)
+    _nan_injecting(tr, fail_at_call=2, transient=True)
+    state, history = tr.fit()  # completes despite the injected NaN
+    assert int(jnp.asarray(state.step)) == 4
+    assert any(not np.isfinite(l) for _, _, l in history["train_loss"])
