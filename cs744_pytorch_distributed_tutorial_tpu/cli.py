@@ -41,6 +41,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--momentum", type=float, default=None)
     p.add_argument("--weight-decay", type=float, default=None)
+    p.add_argument("--optimizer", choices=["sgd", "adamw"], default=None)
+    p.add_argument("--lr-schedule",
+                   choices=["constant", "cosine", "warmup_cosine"], default=None)
+    p.add_argument("--warmup-steps", type=int, default=None)
+    p.add_argument("--total-steps", type=int, default=None,
+                   help="decay horizon for cosine schedules")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-root", default=None)
     p.add_argument("--synthetic-data", action="store_true", default=None,
@@ -95,6 +101,10 @@ _ARG_TO_FIELD = {
     "lr": "learning_rate",
     "momentum": "momentum",
     "weight_decay": "weight_decay",
+    "optimizer": "optimizer",
+    "lr_schedule": "lr_schedule",
+    "warmup_steps": "warmup_steps",
+    "total_steps": "total_steps",
     "seed": "seed",
     "data_root": "data_root",
     "synthetic_data": "synthetic_data",

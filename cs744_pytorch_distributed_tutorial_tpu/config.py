@@ -47,13 +47,21 @@ class TrainConfig:
     synthetic_train_size: int = 50_000
     synthetic_test_size: int = 10_000
 
-    # Optimization (reference: master/part1/part1.py:98-101)
+    # Optimization (reference: master/part1/part1.py:98-101). The
+    # reference's only recipe is fixed-LR SGD(momentum); optimizer and
+    # lr_schedule are capability additions resolved by
+    # train/state.py::make_optimizer. Cosine schedules need total_steps
+    # (the horizon); warmup_steps linearly ramps from 0 first.
     global_batch_size: int = 256
     learning_rate: float = 0.1
     momentum: float = 0.9
     weight_decay: float = 1e-4
     epochs: int = 1
     seed: int = 5000
+    optimizer: str = "sgd"  # "sgd" | "adamw"
+    lr_schedule: str = "constant"  # "constant" | "cosine" | "warmup_cosine"
+    warmup_steps: int = 0
+    total_steps: int | None = None  # required by cosine schedules
 
     # Parallelism
     sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp

@@ -100,6 +100,17 @@ class Trainer:
                 f"sync={cfg.sync!r} shards the optimizer state and supplies its "
                 "own update; it cannot combine with fused_optimizer"
             )
+        if self._zero1 or self._fsdp or cfg.fused_optimizer:
+            # These paths implement the reference's fixed-LR SGD update
+            # directly (parallel/zero.py, ops/fused_sgd.py); the optimizer/
+            # schedule registry applies only to the optax path.
+            if cfg.optimizer != "sgd" or cfg.lr_schedule != "constant" or cfg.warmup_steps:
+                raise ValueError(
+                    f"optimizer={cfg.optimizer!r}/lr_schedule={cfg.lr_schedule!r}/"
+                    f"warmup_steps={cfg.warmup_steps} require the default optax "
+                    f"path; sync={cfg.sync!r} fused_optimizer={cfg.fused_optimizer} "
+                    "hard-code SGD(momentum) at a fixed lr"
+                )
         if self._zero1 or self._fsdp:
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpSGD,

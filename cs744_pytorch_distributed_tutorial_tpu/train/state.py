@@ -35,11 +35,57 @@ class TrainState:
     opt_state: Any
 
 
+def make_schedule(cfg: TrainConfig):
+    """Learning-rate schedule: a float (constant) or an optax schedule.
+
+    The reference trains at a fixed lr (``master/part1/part1.py:98``);
+    cosine/warmup schedules are capability additions. Cosine needs the
+    horizon (``total_steps``) up front because the optimizer is built
+    before the data is seen.
+    """
+    if cfg.lr_schedule == "constant":
+        if cfg.warmup_steps:
+            return optax.schedules.linear_schedule(
+                0.0, cfg.learning_rate, cfg.warmup_steps
+            )
+        return cfg.learning_rate
+    if cfg.lr_schedule in ("cosine", "warmup_cosine"):
+        if not cfg.total_steps:
+            raise ValueError(
+                f"lr_schedule={cfg.lr_schedule!r} needs total_steps (the decay "
+                "horizon); set cfg.total_steps = epochs * steps_per_epoch"
+            )
+        # warmup_steps is honored uniformly: "warmup_cosine" is just the
+        # explicit spelling of cosine-with-warmup.
+        warmup = cfg.warmup_steps
+        if warmup:
+            return optax.schedules.warmup_cosine_decay_schedule(
+                init_value=0.0,
+                peak_value=cfg.learning_rate,
+                warmup_steps=warmup,
+                decay_steps=cfg.total_steps,
+            )
+        return optax.schedules.cosine_decay_schedule(
+            cfg.learning_rate, decay_steps=cfg.total_steps
+        )
+    raise ValueError(
+        f"unknown lr_schedule {cfg.lr_schedule!r}; choose from "
+        "('constant', 'cosine', 'warmup_cosine')"
+    )
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    return optax.chain(
-        optax.add_decayed_weights(cfg.weight_decay),
-        optax.trace(decay=cfg.momentum, nesterov=False),
-        optax.scale(-cfg.learning_rate),
+    lr = make_schedule(cfg)
+    if cfg.optimizer == "sgd":
+        return optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.trace(decay=cfg.momentum, nesterov=False),
+            optax.scale_by_learning_rate(lr),
+        )
+    if cfg.optimizer == "adamw":
+        return optax.adamw(learning_rate=lr, weight_decay=cfg.weight_decay)
+    raise ValueError(
+        f"unknown optimizer {cfg.optimizer!r}; choose from ('sgd', 'adamw')"
     )
 
 
