@@ -2,6 +2,19 @@
 
 from cs744_pytorch_distributed_tutorial_tpu.train.state import TrainState, make_optimizer
 from cs744_pytorch_distributed_tutorial_tpu.train.engine import Trainer
-from cs744_pytorch_distributed_tutorial_tpu.train.lm import LMConfig, LMTrainer, SEQ_AXIS
+from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
+    LMConfig,
+    LMState,
+    LMTrainer,
+    SEQ_AXIS,
+)
 
-__all__ = ["TrainState", "make_optimizer", "Trainer", "LMConfig", "LMTrainer", "SEQ_AXIS"]
+__all__ = [
+    "TrainState",
+    "make_optimizer",
+    "Trainer",
+    "LMConfig",
+    "LMState",
+    "LMTrainer",
+    "SEQ_AXIS",
+]

@@ -623,6 +623,8 @@ class Trainer:
         finally:
             if watchdog is not None:
                 watchdog.close()
+            if ckpt is not None:
+                ckpt.close()
         return state, history
 
     def evaluate(

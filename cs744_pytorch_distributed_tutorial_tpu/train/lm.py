@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
@@ -36,6 +37,16 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
 
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
+
+
+@flax.struct.dataclass
+class LMState:
+    """Checkpointable LM training state (utils/checkpoint.py keys saves
+    by ``step``)."""
+
+    step: jax.Array  # scalar int32
+    params: Any
+    opt_state: Any
 
 
 @dataclasses.dataclass
@@ -69,6 +80,22 @@ class LMConfig:
     seq_len: int = 256  # tokens per sequence fed to the model
     learning_rate: float = 1e-3
     seed: int = 0
+
+    # Gradient accumulation: split each device's batch shard into
+    # ``accum_steps`` microbatches, run fwd/bwd per microbatch under
+    # ``lax.scan`` (activations for only ONE microbatch live at a time —
+    # the long-context memory lever), average the gradient sums, and
+    # apply a single optimizer update. With dense FFNs this is
+    # numerically identical to the unaccumulated step up to summation
+    # order; with MoE (moe_experts > 0) expert capacity is computed per
+    # MICROBATCH, so routing/drop decisions — and hence the trajectory —
+    # legitimately differ from the unaccumulated step.
+    accum_steps: int = 1
+
+    # Checkpoint/resume (Orbax, utils/checkpoint.py). fit()'s batch plan
+    # is a pure function of the step index, so restarts resume exactly.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0  # steps; 0 = only at end when dir set
 
     def replace(self, **kw: Any) -> "LMConfig":
         return dataclasses.replace(self, **kw)
@@ -133,6 +160,12 @@ class LMTrainer:
             raise ValueError(
                 f"ulysses needs per-tensor-shard heads ({heads_local}) divisible "
                 f"by the seq axis ({self.seq_size})"
+            )
+        local_batch = cfg.global_batch_size // self.data_size
+        if cfg.accum_steps < 1 or local_batch % cfg.accum_steps:
+            raise ValueError(
+                f"accum_steps {cfg.accum_steps} must divide the per-device "
+                f"batch shard ({local_batch} sequences)"
             )
         self.expert_parallel = bool(
             cfg.moe_expert_parallel and cfg.moe_experts > 0 and self.data_size > 1
@@ -243,15 +276,17 @@ class LMTrainer:
                 g = lax.pmean(g, TENSOR_AXIS)
             return g
 
+        accum = self.cfg.accum_steps
+
         def local_step(params, opt_state, tokens, targets):
-            def loss_fn(p):
+            def loss_fn(p, toks, tgts):
                 # mutable=["losses"] collects each MoE layer's sown
                 # load-balancing aux term (empty when the FFNs are dense).
                 logits, mut = model.apply(
-                    {"params": p}, tokens, mutable=["losses"]
+                    {"params": p}, toks, mutable=["losses"]
                 )
                 ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, targets
+                    logits, tgts
                 ).mean()
                 from cs744_pytorch_distributed_tutorial_tpu.models.moe import (
                     moe_aux_loss,
@@ -273,7 +308,31 @@ class LMTrainer:
             # averaging (spec-aware: tensor-sharded leaves stay local).
             # Equal token counts per shard make pmean of local means the
             # exact global mean.
-            local_loss, grads = jax.value_and_grad(loss_fn)(params)
+            if accum == 1:
+                local_loss, grads = jax.value_and_grad(loss_fn)(
+                    params, tokens, targets
+                )
+            else:
+                # Gradient accumulation: scan over microbatches so only
+                # one microbatch's activations are live at a time; the
+                # gradient SUM accumulates in the carry and averages out.
+                mb_tok = tokens.reshape(accum, -1, tokens.shape[-1])
+                mb_tgt = targets.reshape(accum, -1, targets.shape[-1])
+
+                def body(carry, mb):
+                    g_sum, l_sum = carry
+                    l, g = jax.value_and_grad(loss_fn)(params, mb[0], mb[1])
+                    return (
+                        jax.tree.map(jnp.add, g_sum, g),
+                        l_sum + l,
+                    ), None
+
+                zeros = jax.tree.map(jnp.zeros_like, params)
+                (g_sum, l_sum), _ = lax.scan(
+                    body, (zeros, jnp.zeros((), jnp.float32)), (mb_tok, mb_tgt)
+                )
+                grads = jax.tree.map(lambda g: g / accum, g_sum)
+                local_loss = l_sum / accum
             grads = jax.tree.map(sync_grad, grads, param_specs)
             loss = mean_over_replicas(local_loss)
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -350,16 +409,50 @@ class LMTrainer:
 
     # ------------------------------------------------------------------ loop
     def fit(self, tokens, steps: int) -> tuple[Any, Any, list[float]]:
-        """Minimal loop: cycle batches of ``global_batch_size`` sequences
-        from ``tokens`` [N, seq_len + 1] for ``steps`` steps."""
+        """Cycle batches of ``global_batch_size`` sequences from ``tokens``
+        [N, seq_len + 1] until ``steps`` total steps have run.
+
+        With ``cfg.checkpoint_dir`` set, training resumes exactly from the
+        newest checkpoint: the batch at step k is a pure function of k, so
+        a restarted run replays the identical remaining plan.
+        """
         cfg = self.cfg
         params, opt_state = self.init()
+        start_step = 0
+        ckpt = None
+        if cfg.checkpoint_dir:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
+                Checkpointer,
+            )
+
+            ckpt = Checkpointer(cfg.checkpoint_dir)
+            restored = ckpt.restore_latest(
+                LMState(jnp.zeros((), jnp.int32), params, opt_state)
+            )
+            if restored is not None:
+                start_step = int(jax.device_get(restored.step))
+                params, opt_state = restored.params, restored.opt_state
         losses: list[float] = []
         n = len(tokens)
         b = cfg.global_batch_size
-        for step in range(steps):
-            lo = (step * b) % max(n - b + 1, 1)
-            x, y = self.shard_batch(tokens[lo : lo + b])
-            params, opt_state, m = self.train_step(params, opt_state, x, y)
-            losses.append(float(m["loss"]))
+        try:
+            for step in range(start_step, steps):
+                lo = (step * b) % max(n - b + 1, 1)
+                x, y = self.shard_batch(tokens[lo : lo + b])
+                params, opt_state, m = self.train_step(params, opt_state, x, y)
+                losses.append(float(m["loss"]))
+                if (
+                    ckpt
+                    and cfg.checkpoint_every
+                    and (step + 1) % cfg.checkpoint_every == 0
+                ):
+                    ckpt.save(LMState(jnp.int32(step + 1), params, opt_state))
+            if ckpt is not None:
+                final = max(steps, start_step)
+                ckpt.save(
+                    LMState(jnp.int32(final), params, opt_state), force=True
+                )
+        finally:
+            if ckpt is not None:
+                ckpt.close()
         return params, opt_state, losses

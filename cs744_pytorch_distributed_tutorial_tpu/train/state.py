@@ -83,7 +83,12 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
             optax.scale_by_learning_rate(lr),
         )
     if cfg.optimizer == "adamw":
-        return optax.adamw(learning_rate=lr, weight_decay=cfg.weight_decay)
+        # cfg.momentum maps to b1: Adam's first-moment decay IS its
+        # momentum (the default 0.9 coincides with the reference's SGD
+        # momentum), so the knob stays meaningful across optimizers.
+        return optax.adamw(
+            learning_rate=lr, b1=cfg.momentum, weight_decay=cfg.weight_decay
+        )
     raise ValueError(
         f"unknown optimizer {cfg.optimizer!r}; choose from ('sgd', 'adamw')"
     )
