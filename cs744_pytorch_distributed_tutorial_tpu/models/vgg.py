@@ -44,6 +44,10 @@ class VGG(nn.Module):
     ``momentum=0.9`` on BatchNorm is flax's running-average decay and
     equals torch's ``momentum=0.1`` convention (running = 0.9*running +
     0.1*batch), matching ``nn.BatchNorm2d`` defaults the reference uses.
+    One pinned divergence (tests/test_torch_parity.py): torch stores the
+    Bessel-corrected (n/(n-1)) variance in its running stats, flax the
+    biased batch variance — an O(1/n) eval-mode difference, negligible
+    at the reference's batch sizes.
     """
 
     cfg: Sequence[Any]
