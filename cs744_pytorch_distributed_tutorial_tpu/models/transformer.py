@@ -253,6 +253,11 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 1.25
     expert_axis: str | None = None
     expert_axis_size: int = 1
+    # Rematerialization: recompute each block's activations during the
+    # backward pass instead of storing them (jax.checkpoint via nn.remat)
+    # — the HBM-for-FLOPs trade that makes long sequences fit. Numerics
+    # are identical; only the autodiff schedule changes.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -271,8 +276,9 @@ class TransformerLM(nn.Module):
         x = x + nn.Embed(
             self.max_seq_len, self.d_model, dtype=self.dtype, name="pos_embed"
         )(positions)
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
-            x = Block(
+            x = block_cls(
                 num_heads=self.num_heads,
                 d_ff=self.d_ff,
                 dtype=self.dtype,

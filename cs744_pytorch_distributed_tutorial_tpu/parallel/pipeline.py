@@ -185,9 +185,19 @@ def block_apply(p: dict, x: jax.Array, num_heads: int) -> jax.Array:
     return x + jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
 
 
-def stack_apply(stacked: dict, x: jax.Array, num_heads: int) -> jax.Array:
-    """Apply a stack of blocks (leading layer dim) with one scanned body."""
-    return lax.scan(lambda h, bp: (block_apply(bp, h, num_heads), None), x, stacked)[0]
+def stack_apply(
+    stacked: dict, x: jax.Array, num_heads: int, remat: bool = False
+) -> jax.Array:
+    """Apply a stack of blocks (leading layer dim) with one scanned body.
+
+    ``remat=True`` wraps the block in ``jax.checkpoint``: the backward
+    pass recomputes each block's activations instead of the scan saving
+    them — identical numerics, O(layers) less activation memory, one
+    extra forward of FLOPs."""
+    fn = lambda bp, h: block_apply(bp, h, num_heads)
+    if remat:
+        fn = jax.checkpoint(fn)
+    return lax.scan(lambda h, bp: (fn(bp, h), None), x, stacked)[0]
 
 
 # --------------------------------------------------------------------------
@@ -207,6 +217,10 @@ class PipelineLMConfig:
     data_parallel: int = 1
     pipeline_parallel: int = 2
     num_microbatches: int = 2
+    # Recompute block activations in backward (jax.checkpoint) — the GPipe
+    # memory lever: without it every microbatch's per-layer activations
+    # stay live until its backward tick.
+    remat: bool = False
 
     global_batch_size: int = 8
     seq_len: int = 64
@@ -309,7 +323,7 @@ class PipelineLMTrainer:
             x = params["embed"][tokens] + params["pos"][:t]
             mb = x.reshape(m, b // m, t, cfg.d_model)
             out = spmd_pipeline(
-                lambda sp, h: stack_apply(sp, h, num_heads),
+                lambda sp, h: stack_apply(sp, h, num_heads, remat=cfg.remat),
                 params["blocks"],
                 mb,
                 axis_name=PIPE_AXIS,

@@ -81,6 +81,11 @@ class LMConfig:
     learning_rate: float = 1e-3
     seed: int = 0
 
+    # Rematerialization: recompute block activations in backward instead
+    # of storing them (jax.checkpoint) — identical numerics, O(layers)
+    # less activation HBM, one extra forward of FLOPs.
+    remat: bool = False
+
     # Gradient accumulation: split each device's batch shard into
     # ``accum_steps`` microbatches, run fwd/bwd per microbatch under
     # ``lax.scan`` (activations for only ONE microbatch live at a time —
@@ -200,6 +205,7 @@ class LMTrainer:
             moe_capacity_factor=cfg.moe_capacity_factor,
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
+            remat=cfg.remat,
         )
         self.tx = optax.adamw(cfg.learning_rate)
         # Partition specs: how each GLOBAL param (and its optimizer state)
