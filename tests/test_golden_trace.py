@@ -1,0 +1,45 @@
+"""Golden loss-curve test — SURVEY §4's prescribed replacement for the
+reference's verification-by-eyeball.
+
+The reference establishes cross-part equivalence only by fixed seed
+(5000 everywhere: ``master/part1/part1.py:107``,
+``master/part2a/part2a.py:89-90``) + manually comparing printed loss
+curves. Here the part-3 configuration's first 8 step losses are pinned
+against a recorded trace: any semantic regression in the model, the
+augmentation RNG discipline, the gradient averaging, or the SGD update
+shifts the curve and fails loudly. The gentle learning rate keeps the
+trajectory non-chaotic so the tolerance absorbs compiler-version
+numeric drift without masking real changes.
+"""
+
+import jax
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import shard_global_batch
+from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+# Recorded on the 8-virtual-CPU-device harness (4-device data mesh),
+# tiny_cnn, sync="auto", global batch 32, synthetic CIFAR seed 5000,
+# lr 0.01. Re-record ONLY for a deliberate semantic change.
+GOLDEN = [3.075281, 2.268045, 2.254324, 2.11918, 2.098891, 1.907552,
+          1.650272, 1.748724]
+
+
+def test_part3_loss_curve_matches_golden_trace(mesh4):
+    cfg = TrainConfig(
+        model="tiny_cnn", sync="auto", num_devices=4, global_batch_size=32,
+        synthetic_data=True, synthetic_train_size=128, synthetic_test_size=64,
+        seed=5000, learning_rate=0.01,
+    )
+    tr = Trainer(cfg, mesh=mesh4)
+    state = tr.init()
+    ds = synthetic_cifar10(32, 8, seed=5000)
+    x, y = shard_global_batch(mesh4, ds.train_images, ds.train_labels)
+    key = jax.random.key(cfg.seed)
+    losses = []
+    for _ in range(len(GOLDEN)):
+        state, m = tr.train_step(state, x, y, key)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, GOLDEN, rtol=5e-3)
