@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_false", default=None,
                    help="keep training through NaN/inf losses instead of "
                         "raising NonFiniteLossError")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture an XLA device trace of a few steps here "
+                        "(view in TensorBoard profile / ui.perfetto.dev)")
+    p.add_argument("--profile-start-step", type=int, default=None)
+    p.add_argument("--profile-num-steps", type=int, default=None)
     p.add_argument("--max-restarts", type=int, default=0,
                    help="restart from the newest checkpoint on detected "
                         "training failures (needs --checkpoint-dir)")
@@ -120,6 +125,9 @@ _ARG_TO_FIELD = {
     "step_timeout_s": "step_timeout_s",
     "hang_action": "hang_action",
     "halt_on_nonfinite": "halt_on_nonfinite",
+    "profile_dir": "profile_dir",
+    "profile_start_step": "profile_start_step",
+    "profile_num_steps": "profile_num_steps",
     "coordinator_address": "coordinator_address",
     "num_processes": "num_processes",
     "process_id": "process_id",

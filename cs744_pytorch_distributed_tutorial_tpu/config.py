@@ -115,6 +115,15 @@ class TrainConfig:
     step_timeout_s: float | None = None
     hang_action: str = "log"  # "log" | "abort"
 
+    # Profiler capture (utils/profiling.py — SURVEY §5.1): when
+    # profile_dir is set, fit() records an XLA device trace of
+    # [profile_start_step, profile_start_step + profile_num_steps) —
+    # viewable in TensorBoard's profile plugin or ui.perfetto.dev.
+    # Start defaults past step 0 so compilation stays out of the trace.
+    profile_dir: str | None = None
+    profile_start_step: int = 10
+    profile_num_steps: int = 5
+
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
 

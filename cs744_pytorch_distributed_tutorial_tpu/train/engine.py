@@ -29,6 +29,7 @@ collectives explicitly per parameter, mirroring the reference's
 
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Any, Iterator
 
@@ -521,6 +522,21 @@ class Trainer:
         # timing window excludes step 0 (utils/timing.py, SURVEY §7d).
         compile_pending = True
 
+        profiling_active = False
+        if cfg.profile_dir:
+            from cs744_pytorch_distributed_tutorial_tpu.utils import profiling
+
+        def stop_profile(fence_metrics) -> None:
+            """Close an open capture; fence on the last step's loss so the
+            traced window contains its async device work."""
+            nonlocal profiling_active
+            if not profiling_active:
+                return
+            if fence_metrics is not None:
+                float(fence_metrics["loss"])
+            jax.profiler.stop_trace()
+            profiling_active = False
+
         try:
             for epoch in range(
                 start_epoch, epochs if epochs is not None else cfg.epochs
@@ -531,6 +547,7 @@ class Trainer:
                     prefetch(train_loader.epoch(epoch, start=skip), cfg.prefetch_depth),
                     start=skip,
                 )
+                metrics = None
                 while True:
                     # The armed window covers batch acquisition too: a
                     # wedged chip blocks the prefetch producer's device_put
@@ -544,11 +561,38 @@ class Trainer:
                     except StopIteration:
                         if arm_now:
                             watchdog.disarm()
+                        # A window still open at epoch end closes HERE so
+                        # the capture never swallows eval/checkpointing.
+                        stop_profile(metrics)
                         break
-                    state, metrics = self.train_step(state, x, y, base_key)
+                    # Range check (not ==): a resume that lands inside the
+                    # window still traces its remainder; landing past it
+                    # skips cleanly; profile_num_steps=0 never starts.
+                    if (
+                        cfg.profile_dir
+                        and not profiling_active
+                        and cfg.profile_start_step
+                        <= steps_done
+                        < cfg.profile_start_step + cfg.profile_num_steps
+                    ):
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling_active = True
+                    step_ctx = (
+                        profiling.step_annotation("train", steps_done)
+                        if profiling_active
+                        else contextlib.nullcontext()
+                    )
+                    with step_ctx:
+                        state, metrics = self.train_step(state, x, y, base_key)
                     # jit's first call traced+compiled synchronously above,
                     # so every later iteration runs under the watchdog.
                     compile_pending = False
+                    if (
+                        profiling_active
+                        and steps_done + 1
+                        >= cfg.profile_start_step + cfg.profile_num_steps
+                    ):
+                        stop_profile(metrics)
                     # Fetch the loss value only while timing or logging needs
                     # it — otherwise leave dispatch fully async so the host
                     # stages batch N+1 while the device runs batch N. The fetch
@@ -621,6 +665,7 @@ class Trainer:
             if ckpt is not None:
                 guarded_save(state, force=True)
         finally:
+            stop_profile(None)  # exception path: close without a fence
             if watchdog is not None:
                 watchdog.close()
             if ckpt is not None:

@@ -55,9 +55,17 @@ TINY_DP4_CFG = dict(
 )
 
 
-def run_tiny_dp4_steps(sync: str, mesh, steps: int = 4):
+def run_tiny_dp4_steps(
+    sync: str,
+    mesh,
+    steps: int = 4,
+    cfg_overrides: dict | None = None,
+    data_seed: int = 0,
+):
     """Train ``steps`` repeats of one fixed synthetic batch under strategy
-    ``sync``; returns (losses, trainer, final_state)."""
+    ``sync``; returns (losses, trainer, final_state). The ONE canonical
+    step-driving discipline for the parity/golden suites — per-step
+    randomness comes from the trainer folding cfg.seed with the step."""
     import jax
 
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
@@ -67,10 +75,10 @@ def run_tiny_dp4_steps(sync: str, mesh, steps: int = 4):
     )
     from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
-    cfg = TrainConfig(**TINY_DP4_CFG, sync=sync)
+    cfg = TrainConfig(**TINY_DP4_CFG, sync=sync, **(cfg_overrides or {}))
     tr = Trainer(cfg, mesh=mesh)
     state = tr.init()
-    ds = synthetic_cifar10(TINY_DP4_CFG["global_batch_size"], 8, seed=0)
+    ds = synthetic_cifar10(TINY_DP4_CFG["global_batch_size"], 8, seed=data_seed)
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
     losses = []

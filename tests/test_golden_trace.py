@@ -12,13 +12,8 @@ trajectory non-chaotic so the tolerance absorbs compiler-version
 numeric drift without masking real changes.
 """
 
-import jax
 import numpy as np
-
-from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
-from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
-from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import shard_global_batch
-from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+from conftest import run_tiny_dp4_steps
 
 # Recorded on the 8-virtual-CPU-device harness (4-device data mesh),
 # tiny_cnn, sync="auto", global batch 32, synthetic CIFAR seed 5000,
@@ -28,18 +23,11 @@ GOLDEN = [3.075281, 2.268045, 2.254324, 2.11918, 2.098891, 1.907552,
 
 
 def test_part3_loss_curve_matches_golden_trace(mesh4):
-    cfg = TrainConfig(
-        model="tiny_cnn", sync="auto", num_devices=4, global_batch_size=32,
-        synthetic_data=True, synthetic_train_size=128, synthetic_test_size=64,
-        seed=5000, learning_rate=0.01,
+    losses, _, _ = run_tiny_dp4_steps(
+        "auto",
+        mesh4,
+        steps=len(GOLDEN),
+        cfg_overrides=dict(seed=5000, learning_rate=0.01),
+        data_seed=5000,
     )
-    tr = Trainer(cfg, mesh=mesh4)
-    state = tr.init()
-    ds = synthetic_cifar10(32, 8, seed=5000)
-    x, y = shard_global_batch(mesh4, ds.train_images, ds.train_labels)
-    key = jax.random.key(cfg.seed)
-    losses = []
-    for _ in range(len(GOLDEN)):
-        state, m = tr.train_step(state, x, y, key)
-        losses.append(float(m["loss"]))
     np.testing.assert_allclose(losses, GOLDEN, rtol=5e-3)
