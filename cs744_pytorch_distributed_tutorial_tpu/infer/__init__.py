@@ -1,0 +1,6 @@
+from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
+    make_generator,
+    sample_tokens,
+)
+
+__all__ = ["make_generator", "sample_tokens"]

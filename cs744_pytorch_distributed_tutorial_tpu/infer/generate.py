@@ -1,0 +1,161 @@
+"""Autoregressive generation: jitted KV-cache prefill + decode.
+
+No counterpart exists in the reference (it trains and evaluates a conv
+classifier only, ``master/part1/part1.py:47-62``) — this is the inference
+half of the long-context model family (``models/transformer.py``),
+designed TPU-first:
+
+- the whole generation loop is ONE jitted program: a prefill pass over
+  the prompt followed by a ``lax.scan`` over decode steps. No per-token
+  Python dispatch, no host round-trips inside the loop;
+- every shape is static: the KV cache is a fixed ``[B, max_seq_len, H, D]``
+  buffer per layer updated in place with ``lax.dynamic_update_slice``
+  (XLA aliases the donated buffer — no reallocation per token), and
+  early EOS termination is a ``done`` mask rather than a dynamic break;
+- sampling is pure ``jax.random``: temperature, top-k, and top-p
+  (nucleus) restrictions are all expressed as static masking of the
+  logits, so any combination traces into the same program.
+
+Decode-step correctness is pinned against the full forward pass in
+``tests/test_generate.py``: cached logits match teacher-forced logits at
+every position.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # additive mask: exp() underflows to exactly 0.0, no NaNs
+
+
+def sample_tokens(
+    logits: jax.Array,
+    key: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Sample token ids from ``[B, V]`` logits.
+
+    ``temperature == 0.0`` is greedy argmax (the limit case, special-cased
+    because dividing by zero is not it). ``top_k`` keeps the k highest
+    logits; ``top_p`` keeps the smallest set of tokens whose cumulative
+    probability reaches p (the highest-probability token always survives).
+    Both restrict by masking, so they compose: top-k first, then top-p
+    over the survivors, matching the conventional filtering order.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, _NEG)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        # Keep a sorted position while the mass BEFORE it is < p (so the
+        # top token is always kept); the cutoff logit is the smallest
+        # kept one.
+        cumulative = jnp.cumsum(probs, axis=-1) - probs
+        kept = cumulative < top_p
+        cutoff = jnp.min(
+            jnp.where(kept, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= cutoff, logits, _NEG)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def make_generator(
+    model: Any,
+    *,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+):
+    """Build a jitted ``generate(params, prompt, key) -> [B, max_new_tokens]``.
+
+    ``model`` is a ``TransformerLM`` configured for single-sequence
+    execution (``seq_axis=None``, ``tensor_axis=None``) — generation runs
+    outside ``shard_map``; scale over batch comes from jit's data
+    sharding. Parameters from a sequence-parallel training run drop in
+    directly (attention has no parameters, so the trees are identical).
+
+    Once a row emits ``eos_id`` it is done: later positions hold
+    ``pad_id`` and its cache stops mattering. The loop still runs
+    ``max_new_tokens`` steps (static shapes); callers needing the speedup
+    of a dynamic stop should shrink ``max_new_tokens`` instead.
+    """
+    if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
+        raise ValueError(
+            "generation needs a model with seq_axis=None; construct a decode "
+            "copy of the model (same dims) — trained params drop in directly"
+        )
+    if getattr(model, "tensor_axis", None) is not None and model.tensor_axis_size > 1:
+        raise ValueError(
+            "generation does not run under tensor parallelism; construct a "
+            "decode copy with tensor_axis=None from gathered full params"
+        )
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+
+    def generate(params, prompt: jax.Array, key: jax.Array) -> jax.Array:
+        b, t0 = prompt.shape
+        if t0 + max_new_tokens > model.max_seq_len:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({model.max_seq_len}) — the cache/positions size"
+            )
+        logits, variables = model.apply(
+            {"params": params}, prompt, mode="prefill", mutable=["cache"]
+        )
+        carry = (
+            variables["cache"],
+            logits[:, -1].astype(jnp.float32),
+            jnp.asarray(t0, jnp.int32),
+            jnp.zeros((b,), jnp.bool_),
+        )
+
+        def body(carry, step_key):
+            cache, last_logits, pos, done = carry
+            tok = sample_tokens(
+                last_logits,
+                step_key,
+                temperature=temperature,
+                top_k=top_k,
+                top_p=top_p,
+            )
+            tok = jnp.where(done, pad_id, tok)
+            if eos_id is not None:
+                done = done | (tok == eos_id)
+            next_logits, mutated = model.apply(
+                {"params": params, "cache": cache},
+                tok[:, None],
+                mode="decode",
+                decode_pos=pos,
+                mutable=["cache"],
+            )
+            new_carry = (
+                mutated["cache"],
+                next_logits[:, 0].astype(jnp.float32),
+                pos + 1,
+                done,
+            )
+            return new_carry, tok
+
+        _, tokens = lax.scan(body, carry, jax.random.split(key, max_new_tokens))
+        return tokens.T  # [max_new_tokens, B] -> [B, max_new_tokens]
+
+    return jax.jit(generate)

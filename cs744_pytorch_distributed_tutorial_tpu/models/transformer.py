@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+    decode_attention,
     dense_attention,
     ring_attention,
     ulysses_attention,
@@ -73,12 +74,25 @@ class Attention(nn.Module):
     tensor_axis_size: int = 1
     causal: bool = True
     flash_interpret: bool | None = None  # None = probe default backend
+    # KV-cache length for autoregressive decoding (infer/generate.py);
+    # required when __call__ runs in "prefill"/"decode" mode.
+    max_decode_len: int | None = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        mode: str = "train",
+        decode_pos: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
         if self.impl not in ATTENTION_IMPLS:
             raise ValueError(
                 f"unknown attention impl {self.impl!r}; choose from {ATTENTION_IMPLS}"
+            )
+        if mode not in ("train", "prefill", "decode"):
+            raise ValueError(
+                f"unknown mode {mode!r}; choose from ('train', 'prefill', 'decode')"
             )
         b, t, d_model = x.shape
         if d_model % self.num_heads:
@@ -106,7 +120,51 @@ class Attention(nn.Module):
         shape = (b, t, heads_local, head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
 
-        if self.seq_axis is None or self.seq_axis_size == 1:
+        decode_step = False
+        if mode != "train":
+            # Cached prefill/decode (infer/generate.py): the cache holds
+            # the FULL sequence, so the sequence axis must be unsharded
+            # (generation runs outside shard_map; data parallelism comes
+            # from jit's batch sharding instead).
+            if self.seq_axis is not None and self.seq_axis_size > 1:
+                raise ValueError(
+                    "cached prefill/decode requires an unsharded sequence "
+                    f"axis; got seq_axis={self.seq_axis!r} "
+                    f"(size {self.seq_axis_size})"
+                )
+            if self.max_decode_len is None:
+                raise ValueError(
+                    f"mode={mode!r} needs max_decode_len (the KV-cache length)"
+                )
+            cache_shape = (b, self.max_decode_len, heads_local, head_dim)
+            ck = self.variable("cache", "cached_key", jnp.zeros, cache_shape, k.dtype)
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros, cache_shape, v.dtype
+            )
+            if mode == "prefill":
+                # Write the prompt's K/V at positions [0, t); attention
+                # itself is the ordinary causal pass below.
+                ck.value = lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
+            else:
+                if decode_pos is None:
+                    raise ValueError("mode='decode' needs decode_pos")
+                if t != 1:
+                    raise ValueError(
+                        f"mode='decode' is a single-token step, got t={t}; "
+                        "feed multi-token chunks through mode='prefill'"
+                    )
+                ck.value = lax.dynamic_update_slice(
+                    ck.value, k, (0, decode_pos, 0, 0)
+                )
+                cv.value = lax.dynamic_update_slice(
+                    cv.value, v, (0, decode_pos, 0, 0)
+                )
+                decode_step = True
+
+        if decode_step:
+            out = decode_attention(q, ck.value, cv.value, decode_pos)
+        elif self.seq_axis is None or self.seq_axis_size == 1:
             if self.impl == "flash":
                 from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
                     flash_attention,
@@ -161,9 +219,16 @@ class Block(nn.Module):
     moe_capacity_factor: float = 1.25
     expert_axis: str | None = None
     expert_axis_size: int = 1
+    max_decode_len: int | None = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        *,
+        mode: str = "train",
+        decode_pos: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
         tp = self.tensor_axis is not None and self.tensor_axis_size > 1
         # The MoE path never shards d_ff over the tensor axis (experts
         # compute replicated), so the divisibility constraint applies to
@@ -186,8 +251,9 @@ class Block(nn.Module):
             tensor_axis_size=self.tensor_axis_size,
             causal=self.causal,
             flash_interpret=self.flash_interpret,
+            max_decode_len=self.max_decode_len,
             name="attn",
-        )(h)
+        )(h, mode=mode, decode_pos=decode_pos)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         if self.num_experts > 0:
             from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN
@@ -260,25 +326,39 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray) -> jnp.ndarray:
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        *,
+        mode: str = "train",
+        decode_pos: jnp.ndarray | None = None,
+    ) -> jnp.ndarray:
         b, t_local = tokens.shape
         x = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
         )(tokens)
         # Global positions: a sequence-sharded block starts at the
-        # device's offset along the seq axis, not at 0.
-        offset = (
-            lax.axis_index(self.seq_axis) * t_local
-            if self.seq_axis is not None and self.seq_axis_size > 1
-            else 0
-        )
+        # device's offset along the seq axis, not at 0; a cached decode
+        # step sits at its decode position.
+        if mode == "decode":
+            if decode_pos is None:
+                raise ValueError("mode='decode' needs decode_pos")
+            offset = decode_pos
+        else:
+            offset = (
+                lax.axis_index(self.seq_axis) * t_local
+                if self.seq_axis is not None and self.seq_axis_size > 1
+                else 0
+            )
         positions = offset + jnp.arange(t_local)
         x = x + nn.Embed(
             self.max_seq_len, self.d_model, dtype=self.dtype, name="pos_embed"
         )(positions)
-        block_cls = nn.remat(Block) if self.remat else Block
+        # Remat applies to the training path only: decoding has no
+        # backward pass whose activation memory it could save.
+        block_cls = nn.remat(Block) if self.remat and mode == "train" else Block
         for i in range(self.num_layers):
-            x = block_cls(
+            block = block_cls(
                 num_heads=self.num_heads,
                 d_ff=self.d_ff,
                 dtype=self.dtype,
@@ -294,8 +374,14 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 expert_axis=self.expert_axis,
                 expert_axis_size=self.expert_axis_size,
+                max_decode_len=self.max_seq_len,
                 name=f"block_{i}",
-            )(x)
+            )
+            # remat (train-only) rejects non-array kwargs; the defaults
+            # ARE train mode, so pass the decode kwargs only off of it.
+            x = block(x) if mode == "train" else block(
+                x, mode=mode, decode_pos=decode_pos
+            )
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(
             self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"

@@ -66,6 +66,34 @@ def dense_attention(
     )
 
 
+def decode_attention(
+    q: jax.Array,
+    cached_k: jax.Array,
+    cached_v: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """One autoregressive decode step against a KV cache.
+
+    ``q`` is [B, 1, H, D] (the new token's query); ``cached_k``/``cached_v``
+    are [B, L, H, D] caches whose entries at positions > ``pos`` (the new
+    token's global position) are unwritten garbage — masked out here, so
+    softmax weights for them are exactly 0.0 and the result matches
+    ``dense_attention`` over the first ``pos+1`` positions. Same numerics
+    discipline as the other variants: float32 scores/softmax, PV matmul in
+    the cache dtype.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, cached_k, preferred_element_type=jnp.float32
+    ) * scale
+    k_pos = jnp.arange(cached_k.shape[1])
+    scores = jnp.where(k_pos[None, None, None, :] <= pos, scores, _MASK)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(cached_v.dtype), cached_v,
+    )
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,

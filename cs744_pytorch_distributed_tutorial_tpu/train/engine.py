@@ -664,6 +664,21 @@ class Trainer:
                     pending_ckpt = None
             if ckpt is not None:
                 guarded_save(state, force=True)
+            if (
+                cfg.profile_dir
+                and cfg.profile_num_steps
+                and steps_done <= cfg.profile_start_step
+            ):
+                # The requested window never opened — say so instead of
+                # leaving an empty trace directory to be discovered in
+                # TensorBoard.
+                self.log.warning(
+                    "profile window [%d, %d) never opened: run ended after "
+                    "%d steps; lower profile_start_step",
+                    cfg.profile_start_step,
+                    cfg.profile_start_step + cfg.profile_num_steps,
+                    steps_done,
+                )
         finally:
             stop_profile(None)  # exception path: close without a fence
             if watchdog is not None:

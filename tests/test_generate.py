@@ -1,0 +1,161 @@
+"""KV-cache generation (infer/generate.py) against the full forward pass.
+
+The correctness anchor: cached prefill+decode must produce the same
+logits as teacher-forcing the full sequence through the model — the
+decode path shares parameters but not code with the training path, so
+this pins the cache indexing, masking, and position handling.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator, sample_tokens
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    model = TransformerLM(
+        vocab_size=VOCAB,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=32,
+        attention_impl="dense",
+    )
+    toks = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), toks)["params"]
+    return model, params
+
+
+def test_decode_logits_match_full_forward(tiny_lm):
+    model, params = tiny_lm
+    tokens = jax.random.randint(jax.random.key(1), (2, 12), 0, VOCAB)
+    full_logits = model.apply({"params": params}, tokens)
+
+    t0 = 5
+    prefill_logits, variables = model.apply(
+        {"params": params}, tokens[:, :t0], mode="prefill", mutable=["cache"]
+    )
+    np.testing.assert_allclose(
+        prefill_logits, full_logits[:, :t0], rtol=1e-5, atol=1e-5
+    )
+
+    cache = variables["cache"]
+    for pos in range(t0, tokens.shape[1]):
+        step_logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, pos : pos + 1],
+            mode="decode",
+            decode_pos=jnp.asarray(pos, jnp.int32),
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        np.testing.assert_allclose(
+            step_logits[:, 0], full_logits[:, pos], rtol=1e-5, atol=1e-5
+        )
+
+
+def test_greedy_generation_matches_naive_loop(tiny_lm):
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(2), (2, 6), 0, VOCAB)
+    n_new = 8
+
+    generate = make_generator(model, max_new_tokens=n_new, temperature=0.0)
+    fast = generate(params, prompt, jax.random.key(3))
+
+    # Naive: re-run the FULL forward pass on the growing sequence each step.
+    seq = prompt
+    naive = []
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        naive.append(nxt)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(fast), np.stack(naive, axis=1))
+
+
+def test_top_k_1_equals_greedy(tiny_lm):
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, VOCAB)
+    greedy = make_generator(model, max_new_tokens=5, temperature=0.0)
+    topk1 = make_generator(model, max_new_tokens=5, temperature=0.7, top_k=1)
+    np.testing.assert_array_equal(
+        np.asarray(greedy(params, prompt, jax.random.key(5))),
+        np.asarray(topk1(params, prompt, jax.random.key(6))),
+    )
+
+
+def test_sampling_is_reproducible_and_in_vocab(tiny_lm):
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(7), (3, 4), 0, VOCAB)
+    generate = make_generator(
+        model, max_new_tokens=6, temperature=0.9, top_k=20, top_p=0.95
+    )
+    a = generate(params, prompt, jax.random.key(8))
+    b = generate(params, prompt, jax.random.key(8))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (3, 6)
+    assert (np.asarray(a) >= 0).all() and (np.asarray(a) < VOCAB).all()
+
+
+def test_eos_rows_pad_after_stop(tiny_lm):
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(9), (2, 4), 0, VOCAB)
+    ref = make_generator(model, max_new_tokens=6, temperature=0.0)
+    first = np.asarray(ref(params, prompt, jax.random.key(0)))[:, 0]
+    eos = int(first[0])  # make row 0's very first token the EOS
+
+    pad = VOCAB + 7  # out-of-vocab sentinel so padding is unmistakable
+    gen = make_generator(
+        model, max_new_tokens=6, temperature=0.0, eos_id=eos, pad_id=pad
+    )
+    out = np.asarray(gen(params, prompt, jax.random.key(0)))
+    for row in out:
+        hits = np.flatnonzero(row == eos)
+        if hits.size:
+            assert (row[hits[0] + 1 :] == pad).all()
+        else:
+            assert (row != pad).all()
+
+
+def test_sample_tokens_top_p_keeps_top_token():
+    # One dominant logit: top_p tiny must still sample it.
+    logits = jnp.array([[0.0, 10.0, 0.0, 0.0]])
+    tok = sample_tokens(logits, jax.random.key(0), temperature=1.0, top_p=0.01)
+    assert int(tok[0]) == 1
+
+
+def test_generation_rejects_overlong_request(tiny_lm):
+    model, params = tiny_lm
+    prompt = jnp.zeros((1, 30), jnp.int32)
+    generate = make_generator(model, max_new_tokens=5, temperature=0.0)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        generate(params, prompt, jax.random.key(0))
+
+
+def test_generation_with_bfloat16_and_remat_variants():
+    """Decode works for the bf16 compute path and ignores remat."""
+    model = TransformerLM(
+        vocab_size=VOCAB,
+        num_layers=1,
+        num_heads=2,
+        d_model=16,
+        d_ff=32,
+        max_seq_len=16,
+        attention_impl="dense",
+        dtype=jnp.bfloat16,
+        remat=True,
+    )
+    toks = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), toks)["params"]
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, VOCAB)
+    out = make_generator(model, max_new_tokens=4, temperature=0.0)(
+        params, prompt, jax.random.key(2)
+    )
+    assert out.shape == (2, 4)
