@@ -35,6 +35,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync", default=None,
                    help="gradient sync strategy (overrides --part)")
     p.add_argument("--model", default=None, help="model name (default vgg11)")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="square input resolution (default 32; >64 selects "
+                        "the ImageNet ResNet stem, synthetic data only)")
+    p.add_argument("--num-classes", type=int, default=None)
+    p.add_argument("--imagenet-stem", action="store_true", default=None,
+                   help="force the 7x7/stride-2 + maxpool ResNet stem")
     p.add_argument("--num-devices", type=int, default=None)
     p.add_argument("--global-batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
@@ -47,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-steps", type=int, default=None)
     p.add_argument("--total-steps", type=int, default=None,
                    help="decay horizon for cosine schedules")
+    p.add_argument("--grad-clip-norm", type=float, default=None,
+                   help="clip the global gradient norm before the optimizer")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-root", default=None)
     p.add_argument("--synthetic-data", action="store_true", default=None,
@@ -100,6 +108,9 @@ def build_parser() -> argparse.ArgumentParser:
 _ARG_TO_FIELD = {
     "sync": "sync",
     "model": "model",
+    "image_size": "image_size",
+    "num_classes": "num_classes",
+    "imagenet_stem": "imagenet_stem",
     "num_devices": "num_devices",
     "global_batch_size": "global_batch_size",
     "epochs": "epochs",
@@ -110,6 +121,7 @@ _ARG_TO_FIELD = {
     "lr_schedule": "lr_schedule",
     "warmup_steps": "warmup_steps",
     "total_steps": "total_steps",
+    "grad_clip_norm": "grad_clip_norm",
     "seed": "seed",
     "data_root": "data_root",
     "synthetic_data": "synthetic_data",

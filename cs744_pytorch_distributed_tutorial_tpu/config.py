@@ -42,6 +42,10 @@ class TrainConfig:
     model: str = "vgg11"
     num_classes: int = 10
     image_size: int = 32
+    # ResNet stem selection: None = auto (CIFAR 3x3 stem at image_size
+    # <= 64, ImageNet 7x7/stride-2 + maxpool above); True/False forces.
+    # Ignored by non-ResNet models.
+    imagenet_stem: bool | None = None
     data_root: str = "./data"
     synthetic_data: bool | None = None  # None = auto (synthetic if no local CIFAR-10)
     synthetic_train_size: int = 50_000
@@ -62,6 +66,10 @@ class TrainConfig:
     lr_schedule: str = "constant"  # "constant" | "cosine" | "warmup_cosine"
     warmup_steps: int = 0
     total_steps: int | None = None  # required by cosine schedules
+    # Clip the GLOBAL gradient norm (across all params, after sync) to
+    # this value before the optimizer sees it; None disables. Capability
+    # addition — the reference never clips.
+    grad_clip_norm: float | None = None
 
     # Parallelism
     sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp

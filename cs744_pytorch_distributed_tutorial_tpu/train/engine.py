@@ -91,8 +91,19 @@ class Trainer:
                 f"global batch {cfg.global_batch_size} not divisible by "
                 f"data-axis size {self.axis_size}"
             )
+        model_kw = {}
+        if cfg.model.startswith("resnet"):
+            use_imagenet_stem = (
+                cfg.image_size > 64
+                if cfg.imagenet_stem is None
+                else cfg.imagenet_stem
+            )
+            model_kw["cifar_stem"] = not use_imagenet_stem
         self.model = get_model(
-            cfg.model, num_classes=cfg.num_classes, dtype=resolve_dtype(cfg.compute_dtype)
+            cfg.model,
+            num_classes=cfg.num_classes,
+            dtype=resolve_dtype(cfg.compute_dtype),
+            **model_kw,
         )
         self._zero1 = cfg.sync == "zero1"
         self._fsdp = cfg.sync == "fsdp"
@@ -105,12 +116,18 @@ class Trainer:
             # These paths implement the reference's fixed-LR SGD update
             # directly (parallel/zero.py, ops/fused_sgd.py); the optimizer/
             # schedule registry applies only to the optax path.
-            if cfg.optimizer != "sgd" or cfg.lr_schedule != "constant" or cfg.warmup_steps:
+            if (
+                cfg.optimizer != "sgd"
+                or cfg.lr_schedule != "constant"
+                or cfg.warmup_steps
+                or cfg.grad_clip_norm is not None
+            ):
                 raise ValueError(
                     f"optimizer={cfg.optimizer!r}/lr_schedule={cfg.lr_schedule!r}/"
-                    f"warmup_steps={cfg.warmup_steps} require the default optax "
-                    f"path; sync={cfg.sync!r} fused_optimizer={cfg.fused_optimizer} "
-                    "hard-code SGD(momentum) at a fixed lr"
+                    f"warmup_steps={cfg.warmup_steps}/grad_clip_norm="
+                    f"{cfg.grad_clip_norm} require the default optax path; "
+                    f"sync={cfg.sync!r} fused_optimizer={cfg.fused_optimizer} "
+                    "hard-code unclipped SGD(momentum) at a fixed lr"
                 )
         if self._zero1 or self._fsdp:
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
@@ -419,6 +436,8 @@ class Trainer:
                 synthetic=cfg.synthetic_data,
                 synthetic_train_size=cfg.synthetic_train_size,
                 synthetic_test_size=cfg.synthetic_test_size,
+                image_size=cfg.image_size,
+                num_classes=cfg.num_classes,
             )
         train_loader = BatchLoader(
             dataset.train_images,

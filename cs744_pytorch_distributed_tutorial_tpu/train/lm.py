@@ -80,6 +80,9 @@ class LMConfig:
     seq_len: int = 256  # tokens per sequence fed to the model
     learning_rate: float = 1e-3
     seed: int = 0
+    # Clip the global gradient norm before AdamW sees it; None disables.
+    # The standard long-context stabilizer (loss spikes on long sequences).
+    grad_clip_norm: float | None = None
 
     # Rematerialization: recompute block activations in backward instead
     # of storing them (jax.checkpoint) — identical numerics, O(layers)
@@ -208,6 +211,24 @@ class LMTrainer:
             remat=cfg.remat,
         )
         self.tx = optax.adamw(cfg.learning_rate)
+        if cfg.grad_clip_norm is not None:
+            if cfg.grad_clip_norm <= 0:
+                raise ValueError(
+                    f"grad_clip_norm must be > 0, got {cfg.grad_clip_norm}"
+                )
+            if self.tensor_size > 1 or self.expert_parallel:
+                # The clip transform computes the norm over each device's
+                # LOCAL grads inside shard_map; with tensor- or expert-
+                # sharded params that norm is incomplete AND device-varying
+                # (a replication-divergence bug, not just a wrong bound).
+                raise ValueError(
+                    "grad_clip_norm requires fully replicated gradients; "
+                    f"got tensor_parallel={self.tensor_size}, "
+                    f"expert_parallel={self.expert_parallel}"
+                )
+            self.tx = optax.chain(
+                optax.clip_by_global_norm(cfg.grad_clip_norm), self.tx
+            )
         # Partition specs: how each GLOBAL param (and its optimizer state)
         # splits over the tensor axis. Built once from the init shapes.
         param_shapes = jax.eval_shape(
@@ -240,6 +261,22 @@ class LMTrainer:
             tensor_axis_size=1,
             expert_axis=None,
             expert_axis_size=1,
+        )
+
+    def decode_model(self) -> TransformerLM:
+        """Single-sequence clone for autoregressive generation
+        (``infer/generate.py``): no mesh axes, dense attention over the
+        cache. Trained params drop in directly — they are global arrays
+        (jit re-gathers tensor/expert shards as needed) and attention
+        carries no parameters, so the trees are identical::
+
+            params, _, _ = trainer.fit(tokens, steps)
+            generate = make_generator(trainer.decode_model(),
+                                      max_new_tokens=64, temperature=0.8)
+            out = generate(params, prompt, jax.random.key(0))
+        """
+        return self._init_model().clone(
+            attention_impl="dense", flash_interpret=None, remat=False
         )
 
     def _local_batch_shape(self) -> tuple[int, int]:

@@ -77,21 +77,32 @@ def make_schedule(cfg: TrainConfig):
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     lr = make_schedule(cfg)
     if cfg.optimizer == "sgd":
-        return optax.chain(
+        tx = optax.chain(
             optax.add_decayed_weights(cfg.weight_decay),
             optax.trace(decay=cfg.momentum, nesterov=False),
             optax.scale_by_learning_rate(lr),
         )
-    if cfg.optimizer == "adamw":
+    elif cfg.optimizer == "adamw":
         # cfg.momentum maps to b1: Adam's first-moment decay IS its
         # momentum (the default 0.9 coincides with the reference's SGD
         # momentum), so the knob stays meaningful across optimizers.
-        return optax.adamw(
+        tx = optax.adamw(
             learning_rate=lr, b1=cfg.momentum, weight_decay=cfg.weight_decay
         )
-    raise ValueError(
-        f"unknown optimizer {cfg.optimizer!r}; choose from ('sgd', 'adamw')"
-    )
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.optimizer!r}; choose from ('sgd', 'adamw')"
+        )
+    if cfg.grad_clip_norm is not None:
+        if cfg.grad_clip_norm <= 0:
+            raise ValueError(
+                f"grad_clip_norm must be > 0, got {cfg.grad_clip_norm}"
+            )
+        # Clip FIRST (on the synced gradient), then the optimizer update —
+        # the conventional order, and the one under which the clip bound
+        # means "gradient norm", not "update norm".
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    return tx
 
 
 def init_state(

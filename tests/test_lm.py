@@ -106,6 +106,38 @@ def test_dense_attention_with_seq_parallel_rejected():
                   mesh=make_mesh({"data": 2, "seq": 4}))
 
 
+def test_grad_clip_changes_trajectory_and_stays_replicated():
+    """Clipped AdamW runs the distributed step; a binding bound changes
+    the trajectory; params remain replicated (the clip factor must be
+    identical on every device)."""
+    mesh = make_mesh({"data": 2, "seq": 4})
+    tokens = synthetic_tokens(16, SMALL["seq_len"], SMALL["vocab_size"], seed=11)
+    params = {}
+    for clip in (None, 1e-4):
+        cfg = LMConfig(**SMALL, attention_impl="ring",
+                       data_parallel=2, seq_parallel=4, grad_clip_norm=clip)
+        tr = LMTrainer(cfg, mesh=mesh)
+        p, _, losses = tr.fit(tokens, steps=3)
+        assert np.isfinite(losses).all()
+        params[clip] = p
+    leaf = jax.tree.leaves(params[1e-4])[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_allclose(s, shards[0], rtol=1e-6)
+    a = jax.tree.leaves(jax.device_get(params[None]))
+    b = jax.tree.leaves(jax.device_get(params[1e-4]))
+    assert any(not np.allclose(x, y) for x, y in zip(a, b))
+
+
+def test_grad_clip_rejected_under_tensor_parallel():
+    with pytest.raises(ValueError, match="replicated gradients"):
+        LMTrainer(
+            LMConfig(**SMALL, attention_impl="ring", data_parallel=2,
+                     seq_parallel=1, tensor_parallel=4, grad_clip_norm=1.0),
+            mesh=make_mesh({"data": 2, "seq": 1, "tensor": 4}),
+        )
+
+
 def test_flash_attention_lm_matches_dense_lm():
     """Single-device LM with the Pallas flash kernel == dense eval loss."""
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens as st

@@ -99,6 +99,50 @@ def test_adamw_trains(mesh4):
     ), "adamw trajectory should differ from sgd's"
 
 
+def test_grad_clip_bounds_update_norm():
+    """With momentum/wd off, SGD's update is -lr * clipped_grad: feeding a
+    gradient of huge norm must produce an update of norm exactly
+    lr * clip."""
+    import jax.numpy as jnp
+    import optax
+
+    cfg = TrainConfig(
+        momentum=0.0, weight_decay=0.0, learning_rate=0.5, grad_clip_norm=1.0
+    )
+    tx = make_optimizer(cfg)
+    params = {"w": jnp.zeros((4,)), "b": jnp.zeros((2,))}
+    grads = {"w": jnp.full((4,), 1e6), "b": jnp.full((2,), -1e6)}
+    updates, _ = tx.update(grads, tx.init(params), params)
+    norm = float(optax.global_norm(updates))
+    assert norm == pytest.approx(cfg.learning_rate * 1.0, rel=1e-5)
+
+    # A small gradient passes through unclipped.
+    small = {"w": jnp.full((4,), 1e-3), "b": jnp.full((2,), 1e-3)}
+    updates, _ = tx.update(small, tx.init(params), params)
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), -cfg.learning_rate * np.asarray(small["w"]),
+        rtol=1e-6,
+    )
+
+    with pytest.raises(ValueError, match="grad_clip_norm"):
+        make_optimizer(TrainConfig(grad_clip_norm=-1.0))
+
+
+def test_grad_clip_trains_distributed(mesh4):
+    """The clipped chain runs the full distributed step and changes the
+    trajectory when the bound binds."""
+    losses, _, st_clip = run_tiny_dp4_steps(
+        "allreduce", mesh4, cfg_overrides={"grad_clip_norm": 1e-3}
+    )
+    assert np.isfinite(losses).all()
+    _, _, st_ref = run_tiny_dp4_steps("allreduce", mesh4)
+    p_clip = jax.tree.leaves(jax.device_get(st_clip.params))
+    p_ref = jax.tree.leaves(jax.device_get(st_ref.params))
+    assert any(
+        not np.allclose(a, b) for a, b in zip(p_clip, p_ref)
+    ), "a binding clip bound should change the trajectory"
+
+
 def test_sharded_optimizers_reject_custom_recipe(mesh4):
     """zero1/fsdp/fused hard-code the reference SGD update; the registry
     knobs must be rejected loudly, not silently ignored."""
@@ -117,5 +161,10 @@ def test_sharded_optimizers_reject_custom_recipe(mesh4):
                 lr_schedule="cosine",
                 total_steps=10,
             ),
+            mesh=mesh4,
+        )
+    with pytest.raises(ValueError, match="optax path"):
+        Trainer(
+            TrainConfig(**TINY_DP4_CFG, sync="zero1", grad_clip_norm=1.0),
             mesh=mesh4,
         )
