@@ -15,6 +15,7 @@ from cs744_pytorch_distributed_tutorial_tpu.data.cifar10 import (
     CIFAR10Dataset,
     load_cifar10,
     synthetic_cifar10,
+    synthetic_images,
 )
 from cs744_pytorch_distributed_tutorial_tpu.data.loader import BatchLoader
 from cs744_pytorch_distributed_tutorial_tpu.data.native_batcher import gather_rows
@@ -40,5 +41,6 @@ __all__ = [
     "prefetch",
     "PrefetchIterator",
     "synthetic_cifar10",
+    "synthetic_images",
     "synthetic_tokens",
 ]

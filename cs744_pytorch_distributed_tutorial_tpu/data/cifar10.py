@@ -44,10 +44,17 @@ def _read_batch(path: str) -> tuple[np.ndarray, np.ndarray]:
     return images, labels
 
 
-def synthetic_cifar10(
-    train_size: int, test_size: int, seed: int = 0
+def synthetic_images(
+    train_size: int,
+    test_size: int,
+    *,
+    image_size: int = 32,
+    num_classes: int = NUM_CLASSES,
+    seed: int = 0,
 ) -> CIFAR10Dataset:
-    """Deterministic synthetic CIFAR-10 stand-in with learnable structure.
+    """Deterministic synthetic image set with learnable structure, at any
+    resolution / class count (the ImageNet-shaped stand-in for scale-out
+    benchmarks as well as the CIFAR one).
 
     Each class gets a smooth random template image; samples are the class
     template plus pixel noise. Same-class images are therefore closer than
@@ -56,14 +63,17 @@ def synthetic_cifar10(
     reference's "eyeball the loss curve on real data" check (SURVEY §4).
     """
     rng = np.random.default_rng(seed)
-    # Smooth per-class templates: low-resolution noise upsampled 4x, so
+    # Smooth per-class templates: low-resolution noise upsampled, so
     # templates differ at large spatial scale (survives random crops).
-    coarse = rng.uniform(40.0, 215.0, size=(NUM_CLASSES, 8, 8, 3))
-    templates = coarse.repeat(4, axis=1).repeat(4, axis=2)  # [10, 32, 32, 3]
+    coarse = rng.uniform(40.0, 215.0, size=(num_classes, 8, 8, 3))
+    factor = -(-image_size // 8)  # ceil: upsample then crop to size
+    templates = (
+        coarse.repeat(factor, axis=1).repeat(factor, axis=2)
+    )[:, :image_size, :image_size, :]
 
     def make_split(n: int) -> tuple[np.ndarray, np.ndarray]:
-        labels = rng.integers(0, NUM_CLASSES, size=n, dtype=np.int32)
-        noise = rng.normal(0.0, 24.0, size=(n, 32, 32, 3))
+        labels = rng.integers(0, num_classes, size=n, dtype=np.int32)
+        noise = rng.normal(0.0, 24.0, size=(n, image_size, image_size, 3))
         images = np.clip(templates[labels] + noise, 0, 255).astype(np.uint8)
         return images, labels
 
@@ -74,6 +84,15 @@ def synthetic_cifar10(
     )
 
 
+def synthetic_cifar10(
+    train_size: int, test_size: int, seed: int = 0
+) -> CIFAR10Dataset:
+    """CIFAR-shaped synthetic set (32x32, 10 classes) — byte-identical to
+    the round-1 generator (same RNG draw sequence), which the golden-trace
+    test and the benchmark depend on."""
+    return synthetic_images(train_size, test_size, seed=seed)
+
+
 def load_cifar10(
     root: str,
     *,
@@ -81,21 +100,38 @@ def load_cifar10(
     synthetic_train_size: int = 50_000,
     synthetic_test_size: int = 10_000,
     seed: int = 0,
+    image_size: int = 32,
+    num_classes: int = NUM_CLASSES,
 ) -> CIFAR10Dataset:
     """Load CIFAR-10 from ``root`` (torchvision on-disk layout), or fall back.
 
     ``synthetic``: ``None`` = auto (real data if present, else synthetic);
     ``True`` = always synthetic; ``False`` = real data or
     ``FileNotFoundError`` (no silent substitution when the caller demanded
-    the real set).
+    the real set). Non-CIFAR shapes (``image_size``/``num_classes``
+    beyond 32/10 — the ImageNet-shaped configs) are synthetic-only: the
+    only real on-disk format this reads is the CIFAR pickle tree.
     """
+    cifar_shaped = image_size == 32 and num_classes == NUM_CLASSES
     batch_dir = os.path.join(root, _BATCH_DIR)
-    have_real = all(
+    have_real = cifar_shaped and all(
         os.path.exists(os.path.join(batch_dir, f))
         for f in _TRAIN_FILES + [_TEST_FILE]
     )
+    if synthetic is False and not cifar_shaped:
+        raise ValueError(
+            f"real data is CIFAR-10 only (32x32, 10 classes); got "
+            f"image_size={image_size}, num_classes={num_classes} with "
+            "synthetic=False"
+        )
     if synthetic is True or (synthetic is None and not have_real):
-        return synthetic_cifar10(synthetic_train_size, synthetic_test_size, seed)
+        return synthetic_images(
+            synthetic_train_size,
+            synthetic_test_size,
+            image_size=image_size,
+            num_classes=num_classes,
+            seed=seed,
+        )
     if not have_real:
         raise FileNotFoundError(
             f"CIFAR-10 pickle batches not found under {batch_dir!r} and "
