@@ -24,7 +24,11 @@ from cs744_pytorch_distributed_tutorial_tpu.data.prefetch import (
     prefetch,
 )
 from cs744_pytorch_distributed_tutorial_tpu.data.sampler import ShardedSampler
-from cs744_pytorch_distributed_tutorial_tpu.data.text import synthetic_tokens
+from cs744_pytorch_distributed_tutorial_tpu.data.text import (
+    BYTE_VOCAB,
+    byte_corpus,
+    synthetic_tokens,
+)
 
 __all__ = [
     "CIFAR10_MEAN",
@@ -40,6 +44,8 @@ __all__ = [
     "load_cifar10",
     "prefetch",
     "PrefetchIterator",
+    "BYTE_VOCAB",
+    "byte_corpus",
     "synthetic_cifar10",
     "synthetic_images",
     "synthetic_tokens",

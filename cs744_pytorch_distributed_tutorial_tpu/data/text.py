@@ -36,3 +36,45 @@ def synthetic_tokens(
         corrupt, rng.integers(0, vocab_size, size=tokens.shape), tokens
     )
     return tokens.astype(np.int32)
+
+
+BYTE_VOCAB = 256
+
+
+def byte_corpus(
+    path: str,
+    seq_len: int,
+    *,
+    stride: int | None = None,
+    max_seqs: int | None = None,
+    shuffle: bool = True,
+    seed: int = 0,
+) -> np.ndarray:
+    """Byte-level tokenization of a local file -> [N, seq_len + 1] int32.
+
+    The zero-dependency tokenizer (vocab = 256 byte values): windows of
+    ``seq_len + 1`` bytes taken every ``stride`` positions (default
+    non-overlapping), shuffled deterministically so ``LMTrainer.fit``'s
+    sequential batch plan still sees mixed data. Pairs with
+    ``LMConfig(vocab_size=256)``; decode generated ids with
+    ``bytes(ids).decode(errors='replace')``.
+    """
+    data = np.fromfile(path, dtype=np.uint8)
+    window = seq_len + 1
+    if len(data) < window:
+        raise ValueError(
+            f"corpus {path!r} has {len(data)} bytes < seq_len + 1 = {window}"
+        )
+    stride = stride or window
+    if stride < 1:
+        raise ValueError(f"stride must be >= 1, got {stride}")
+    windows = np.lib.stride_tricks.sliding_window_view(data, window)[::stride]
+    tokens = windows.astype(np.int32)
+    if shuffle:
+        rng = np.random.default_rng(seed)
+        tokens = tokens[rng.permutation(len(tokens))]
+    else:
+        tokens = tokens.copy()
+    if max_seqs is not None:
+        tokens = tokens[:max_seqs]
+    return tokens

@@ -1,0 +1,190 @@
+"""CLI for the long-context LM family: train, then optionally generate.
+
+The image classifiers have ``cli.py`` (the reference's part presets);
+this is the transformer counterpart — no analog exists in the reference
+(its only model is conv VGG-11, ``master/part1/model.py:30-46``):
+
+    # train on synthetic tokens over a data x seq mesh:
+    python -m cs744_pytorch_distributed_tutorial_tpu.lm_cli \
+        --data-parallel 2 --seq-parallel 4 --steps 100
+
+    # byte-level LM on any local file, then sample from it:
+    python -m cs744_pytorch_distributed_tutorial_tpu.lm_cli \
+        --text-file README.md --steps 200 --generate 128 \
+        --prompt "The reference" --temperature 0.8 --top-k 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cs744-tpu-lm",
+        description="TPU-native long-context LM training + generation",
+    )
+    # model
+    p.add_argument("--vocab-size", type=int, default=1024,
+                   help="ignored with --text-file (byte vocab = 256)")
+    p.add_argument("--num-layers", type=int, default=4)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--d-ff", type=int, default=1024)
+    p.add_argument("--max-seq-len", type=int, default=2048)
+    p.add_argument("--attention-impl", default="ring",
+                   choices=["ring", "ulysses", "dense", "flash"])
+    p.add_argument("--compute-dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--remat", action="store_true")
+    # MoE
+    p.add_argument("--moe-experts", type=int, default=0)
+    p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-expert-parallel", action="store_true")
+    # mesh
+    p.add_argument("--data-parallel", type=int, default=1)
+    p.add_argument("--seq-parallel", type=int, default=1)
+    p.add_argument("--tensor-parallel", type=int, default=1)
+    # optimization
+    p.add_argument("--global-batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--grad-clip-norm", type=float, default=None)
+    p.add_argument("--accum-steps", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    # data
+    p.add_argument("--text-file", default=None,
+                   help="byte-level corpus from a local file (vocab 256); "
+                        "default is the synthetic cyclic token stream")
+    p.add_argument("--num-seqs", type=int, default=512,
+                   help="synthetic stream size / corpus window cap")
+    # generation
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, sample N tokens")
+    p.add_argument("--prompt", default=None,
+                   help="generation prompt (bytes with --text-file); "
+                        "default: the first training sequence's prefix")
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--json", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import numpy as np
+
+    from cs744_pytorch_distributed_tutorial_tpu.data import (
+        BYTE_VOCAB,
+        byte_corpus,
+        synthetic_tokens,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    if args.text_file:
+        vocab = BYTE_VOCAB
+        tokens = byte_corpus(
+            args.text_file, args.seq_len, max_seqs=args.num_seqs, seed=args.seed
+        )
+    else:
+        vocab = args.vocab_size
+        tokens = synthetic_tokens(
+            args.num_seqs, args.seq_len, vocab, seed=args.seed
+        )
+
+    cfg = LMConfig(
+        vocab_size=vocab,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        d_model=args.d_model,
+        d_ff=args.d_ff,
+        max_seq_len=args.max_seq_len,
+        attention_impl=args.attention_impl,
+        compute_dtype=args.compute_dtype,
+        remat=args.remat,
+        moe_experts=args.moe_experts,
+        moe_top_k=args.moe_top_k,
+        moe_expert_parallel=args.moe_expert_parallel,
+        data_parallel=args.data_parallel,
+        seq_parallel=args.seq_parallel,
+        tensor_parallel=args.tensor_parallel,
+        global_batch_size=args.global_batch_size,
+        seq_len=args.seq_len,
+        learning_rate=args.lr,
+        grad_clip_norm=args.grad_clip_norm,
+        accum_steps=args.accum_steps,
+        seed=args.seed,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+    )
+    trainer = LMTrainer(cfg)
+    params, _, losses = trainer.fit(tokens, steps=args.steps)
+    for i, loss in enumerate(losses):
+        if i % args.log_every == 0 or i == len(losses) - 1:
+            print(f"{i} loss:  {loss:f}")
+
+    sample_text = None
+    sample_ids = None
+    if args.generate > 0:
+        from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+        if args.prompt is not None and args.text_file:
+            prompt_ids = np.frombuffer(
+                args.prompt.encode("utf-8"), dtype=np.uint8
+            ).astype(np.int32)[None, :]
+        elif args.prompt is not None:
+            prompt_ids = np.asarray(
+                [[int(t) for t in args.prompt.split()]], dtype=np.int32
+            )
+        else:
+            prompt_ids = tokens[:1, : args.prompt_len]
+        generate = make_generator(
+            trainer.decode_model(),
+            max_new_tokens=args.generate,
+            temperature=args.temperature,
+            top_k=args.top_k,
+            top_p=args.top_p,
+        )
+        out = generate(
+            jax.device_get(params),
+            np.asarray(prompt_ids, dtype=np.int32),
+            jax.random.key(args.seed),
+        )
+        sample_ids = np.asarray(out)[0].tolist()
+        if args.text_file:
+            sample_text = bytes(sample_ids).decode("utf-8", errors="replace")
+            print(f"sample: {sample_text!r}")
+        else:
+            print(f"sample ids: {sample_ids}")
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "vocab_size": vocab,
+                    "mesh": {
+                        "data": args.data_parallel,
+                        "seq": args.seq_parallel,
+                        "tensor": args.tensor_parallel,
+                    },
+                    "steps": args.steps,
+                    "first_loss": losses[0] if losses else None,
+                    "final_loss": losses[-1] if losses else None,
+                    "sample": sample_text or sample_ids,
+                }
+            )
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,62 @@
+"""LM CLI (lm_cli.py): train + generate end-to-end from flags."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.data import byte_corpus
+from cs744_pytorch_distributed_tutorial_tpu.lm_cli import main
+
+TINY = [
+    "--num-layers", "1", "--num-heads", "2", "--d-model", "16",
+    "--d-ff", "32", "--max-seq-len", "64", "--seq-len", "16",
+    "--global-batch-size", "4", "--num-seqs", "16", "--steps", "2",
+]
+
+
+def test_lm_cli_synthetic_train_and_generate(capsys):
+    rc = main(TINY + [
+        "--vocab-size", "32", "--data-parallel", "2", "--seq-parallel", "2",
+        "--generate", "4", "--prompt-len", "4", "--json",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out.strip().splitlines()[-1])
+    assert summary["steps"] == 2
+    assert np.isfinite(summary["final_loss"])
+    assert len(summary["sample"]) == 4
+    assert all(0 <= t < 32 for t in summary["sample"])
+
+
+def test_lm_cli_byte_corpus(tmp_path, capsys):
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_bytes(b"the quick brown fox jumps over the lazy dog " * 40)
+    rc = main(TINY + [
+        "--text-file", str(corpus), "--attention-impl", "dense",
+        "--generate", "6", "--prompt", "the quick", "--temperature", "0",
+        "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["vocab_size"] == 256
+    assert isinstance(summary["sample"], str) and len(summary["sample"]) == 6
+
+
+def test_byte_corpus_windows(tmp_path):
+    f = tmp_path / "c.bin"
+    f.write_bytes(bytes(range(100)))
+    toks = byte_corpus(str(f), 9, shuffle=False)
+    assert toks.shape == (10, 10)
+    np.testing.assert_array_equal(toks[0], np.arange(10))
+    np.testing.assert_array_equal(toks[1], np.arange(10, 20))
+
+    overlapping = byte_corpus(str(f), 9, stride=1, shuffle=False)
+    assert overlapping.shape == (91, 10)
+
+    shuffled_a = byte_corpus(str(f), 9, seed=1)
+    shuffled_b = byte_corpus(str(f), 9, seed=1)
+    np.testing.assert_array_equal(shuffled_a, shuffled_b)
+
+    with pytest.raises(ValueError, match="bytes"):
+        byte_corpus(str(f), 200)
