@@ -38,6 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--tie-embeddings", action="store_true",
+                   help="share the token embedding with the output head")
     # MoE
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=2)
@@ -111,6 +113,7 @@ def main(argv: list[str] | None = None) -> int:
         attention_impl=args.attention_impl,
         compute_dtype=args.compute_dtype,
         remat=args.remat,
+        tie_embeddings=args.tie_embeddings,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_expert_parallel=args.moe_expert_parallel,

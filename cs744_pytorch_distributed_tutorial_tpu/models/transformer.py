@@ -324,6 +324,11 @@ class TransformerLM(nn.Module):
     # — the HBM-for-FLOPs trade that makes long sequences fit. Numerics
     # are identical; only the autodiff schedule changes.
     remat: bool = False
+    # Weight tying: reuse the token embedding as the output projection
+    # (logits = x @ E^T) instead of a separate lm_head — the standard
+    # vocab-parameter halving; gradients flow to the embedding from both
+    # uses.
+    tie_embeddings: bool = False
 
     @nn.compact
     def __call__(
@@ -334,9 +339,10 @@ class TransformerLM(nn.Module):
         decode_pos: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         b, t_local = tokens.shape
-        x = nn.Embed(
+        tok_embed = nn.Embed(
             self.vocab_size, self.d_model, dtype=self.dtype, name="tok_embed"
-        )(tokens)
+        )
+        x = tok_embed(tokens)
         # Global positions: a sequence-sharded block starts at the
         # device's offset along the seq axis, not at 0; a cached decode
         # step sits at its decode position.
@@ -383,9 +389,12 @@ class TransformerLM(nn.Module):
                 x, mode=mode, decode_pos=decode_pos
             )
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        logits = nn.Dense(
-            self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
-        )(x)
+        if self.tie_embeddings:
+            logits = tok_embed.attend(x)
+        else:
+            logits = nn.Dense(
+                self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
+            )(x)
         return logits.astype(jnp.float32)
 
 

@@ -15,6 +15,7 @@ get their gradients explicitly averaged over all mesh axes.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 import flax.struct
@@ -88,6 +89,10 @@ class LMConfig:
     # of storing them (jax.checkpoint) — identical numerics, O(layers)
     # less activation HBM, one extra forward of FLOPs.
     remat: bool = False
+
+    # Weight tying: logits = x @ tok_embed^T instead of a separate
+    # lm_head (halves the vocab parameters).
+    tie_embeddings: bool = False
 
     # Gradient accumulation: split each device's batch shard into
     # ``accum_steps`` microbatches, run fwd/bwd per microbatch under
@@ -209,6 +214,7 @@ class LMTrainer:
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             remat=cfg.remat,
+            tie_embeddings=cfg.tie_embeddings,
         )
         self.tx = optax.adamw(cfg.learning_rate)
         if cfg.grad_clip_norm is not None:
@@ -449,6 +455,25 @@ class LMTrainer:
             jax.device_put(inputs, sharding),
             jax.device_put(targets, sharding),
         )
+
+    def evaluate(self, params, tokens) -> dict[str, float]:
+        """Held-out evaluation over ``tokens`` [N, seq_len + 1]: mean
+        next-token cross-entropy and perplexity (exp of it). Batches of
+        ``global_batch_size`` sequences; a ragged tail is dropped (like
+        the train loaders' drop_last) so every batch keeps the static
+        shard shape."""
+        b = self.cfg.global_batch_size
+        n_batches = len(tokens) // b
+        if n_batches == 0:
+            raise ValueError(
+                f"need at least global_batch_size={b} sequences, got {len(tokens)}"
+            )
+        total = 0.0
+        for i in range(n_batches):
+            x, y = self.shard_batch(tokens[i * b : (i + 1) * b])
+            total += float(self.eval_step(params, x, y)["loss"])
+        mean_loss = total / n_batches
+        return {"loss": mean_loss, "perplexity": math.exp(mean_loss)}
 
     # ------------------------------------------------------------------ loop
     def fit(self, tokens, steps: int) -> tuple[Any, Any, list[float]]:

@@ -106,6 +106,53 @@ def test_dense_attention_with_seq_parallel_rejected():
                   mesh=make_mesh({"data": 2, "seq": 4}))
 
 
+def test_tied_embeddings_drop_lm_head_and_train():
+    """tie_embeddings removes lm_head from the tree (vocab params halved),
+    the tied logits equal x @ E^T, and training/generation still run."""
+    kw = {k: SMALL[k] for k in ("vocab_size", "num_layers", "num_heads",
+                                "d_model", "d_ff", "max_seq_len")}
+    tied = TransformerLM(**kw, tie_embeddings=True)
+    toks = jnp.zeros((2, 16), jnp.int32)
+    params = tied.init(jax.random.key(0), toks)["params"]
+    assert "lm_head" not in params
+    untied = TransformerLM(**kw).init(jax.random.key(0), toks)["params"]
+    assert "lm_head" in untied
+
+    # Train end-to-end on the seq-parallel mesh + generate.
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    mesh = make_mesh({"data": 2, "seq": 2})
+    cfg = LMConfig(**SMALL, attention_impl="ring", tie_embeddings=True,
+                   data_parallel=2, seq_parallel=2)
+    tr = LMTrainer(cfg, mesh=mesh)
+    tokens = synthetic_tokens(16, cfg.seq_len, cfg.vocab_size, seed=9)
+    p, _, losses = tr.fit(tokens, steps=2)
+    assert np.isfinite(losses).all()
+    out = make_generator(tr.decode_model(), max_new_tokens=3, temperature=0.0)(
+        jax.device_get(p), jnp.asarray(tokens[:1, :8], jnp.int32),
+        jax.random.key(0),
+    )
+    assert out.shape == (1, 3)
+
+
+def test_evaluate_returns_perplexity():
+    mesh = make_mesh({"data": 2, "seq": 2})
+    cfg = LMConfig(**SMALL, attention_impl="ring",
+                   data_parallel=2, seq_parallel=2)
+    tr = LMTrainer(cfg, mesh=mesh)
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+
+    tokens = synthetic_tokens(24, cfg.seq_len, cfg.vocab_size, seed=2)
+    params, _ = tr.init()
+    m = tr.evaluate(params, tokens)
+    # Untrained model on ~uniform tokens: loss near log(vocab), ppl ~ vocab.
+    assert m["loss"] == pytest.approx(np.log(cfg.vocab_size), rel=0.25)
+    assert m["perplexity"] == pytest.approx(np.exp(m["loss"]), rel=1e-6)
+    with pytest.raises(ValueError, match="at least"):
+        tr.evaluate(params, tokens[:2])
+
+
 def test_grad_clip_changes_trajectory_and_stays_replicated():
     """Clipped AdamW runs the distributed step; a binding bound changes
     the trajectory; params remain replicated (the clip factor must be
