@@ -132,6 +132,118 @@ def test_cross_entropy_matches_torch():
     np.testing.assert_allclose(float(ol), float(tl), rtol=1e-6)
 
 
+def test_attention_matches_torch_sdpa():
+    """Our dense causal attention == torch's canonical
+    scaled_dot_product_attention(is_causal=True) on shared projection
+    weights — pins the scale (1/sqrt(head_dim)), masking, and head
+    reshape conventions of the LM family."""
+    import torch.nn.functional as F
+
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import Attention
+
+    b, t, d_model, heads = 2, 10, 32, 4
+    head_dim = d_model // heads
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((b, t, d_model)).astype(np.float32)
+    wq, wk, wv, wo = (
+        (rng.standard_normal((d_model, d_model)).astype(np.float32) * 0.1)
+        for _ in range(4)
+    )
+
+    attn = Attention(num_heads=heads, impl="dense", causal=True)
+    params = {
+        "q": {"kernel": jnp.asarray(wq)},
+        "k": {"kernel": jnp.asarray(wk)},
+        "v": {"kernel": jnp.asarray(wv)},
+        "attn_out": {"kernel": jnp.asarray(wo)},
+    }
+    ours = attn.apply({"params": params}, jnp.asarray(x))
+
+    tx = torch.tensor(x)
+    # y = x @ W (flax Dense kernel convention), heads split like ours:
+    # [B, T, H, Dh] -> SDPA wants [B, H, T, Dh].
+    tq, tk, tv = (
+        (tx @ torch.tensor(w)).reshape(b, t, heads, head_dim).transpose(1, 2)
+        for w in (wq, wk, wv)
+    )
+    tout = F.scaled_dot_product_attention(tq, tk, tv, is_causal=True)
+    tout = tout.transpose(1, 2).reshape(b, t, d_model) @ torch.tensor(wo)
+
+    np.testing.assert_allclose(
+        np.asarray(ours), tout.numpy(), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_layernorm_and_gelu_match_torch():
+    """flax LayerNorm == torch LayerNorm on shared gamma/beta, and the
+    Block's GELU is the tanh approximation (flax nn.gelu's default) — the
+    convention pinned so a torch port knows which variant to use."""
+    import flax.linen as nn
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((4, 16)).astype(np.float32)
+    gamma = rng.standard_normal(16).astype(np.float32)
+    beta = rng.standard_normal(16).astype(np.float32)
+
+    fy = nn.LayerNorm().apply(
+        {"params": {"scale": jnp.asarray(gamma), "bias": jnp.asarray(beta)}},
+        jnp.asarray(x),
+    )
+    ty = F.layer_norm(
+        torch.tensor(x), (16,), torch.tensor(gamma), torch.tensor(beta)
+    )
+    np.testing.assert_allclose(np.asarray(fy), ty.numpy(), rtol=1e-4, atol=1e-5)
+
+    np.testing.assert_allclose(
+        np.asarray(nn.gelu(jnp.asarray(x))),
+        F.gelu(torch.tensor(x), approximate="tanh").numpy(),
+        rtol=1e-4,
+        atol=1e-6,
+    )
+
+
+def test_transformer_block_matches_torch_reimplementation():
+    """The full pre-LN block (ln1 -> attn -> residual -> ln2 -> MLP ->
+    residual) re-built op-by-op in torch from OUR trained params must
+    reproduce our forward — pins the residual wiring, not just the leaf
+    ops."""
+    import torch.nn.functional as F
+
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import Block
+
+    b, t, d_model, heads, d_ff = 2, 8, 16, 2, 48
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((b, t, d_model)).astype(np.float32)
+
+    block = Block(num_heads=heads, d_ff=d_ff, impl="dense", causal=True)
+    variables = block.init(jax.random.key(1), jnp.asarray(x))
+    ours = np.asarray(block.apply(variables, jnp.asarray(x)))
+
+    p = jax.tree.map(lambda a: torch.tensor(np.asarray(a)), variables["params"])
+    tx_in = torch.tensor(x)
+
+    def t_ln(v, ln):
+        return F.layer_norm(v, (v.shape[-1],), ln["scale"], ln["bias"])
+
+    h = t_ln(tx_in, p["ln1"])
+    head_dim = d_model // heads
+    tq, tk, tv = (
+        (h @ p["attn"][k]["kernel"]).reshape(b, t, heads, head_dim).transpose(1, 2)
+        for k in ("q", "k", "v")
+    )
+    a = F.scaled_dot_product_attention(tq, tk, tv, is_causal=True)
+    a = a.transpose(1, 2).reshape(b, t, d_model) @ p["attn"]["attn_out"]["kernel"]
+    mid = tx_in + a
+    h = t_ln(mid, p["ln2"])
+    h = h @ p["mlp_in"]["kernel"] + p["mlp_in"]["bias"]
+    h = F.gelu(h, approximate="tanh")
+    h = h @ p["mlp_out"]["kernel"]
+    out = mid + h + p["mlp_out_bias"]
+
+    np.testing.assert_allclose(ours, out.numpy(), rtol=1e-4, atol=1e-5)
+
+
 def test_vgg11_param_count_matches_torch_reference_shape():
     """Our VGG-11 must have exactly the reference architecture's parameter
     count: 8 convs per the _cfg table + Linear(512, 10) head + BN
