@@ -1,6 +1,7 @@
+from cs744_pytorch_distributed_tutorial_tpu.infer.beam import make_beam_searcher
 from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
     make_generator,
     sample_tokens,
 )
 
-__all__ = ["make_generator", "sample_tokens"]
+__all__ = ["make_beam_searcher", "make_generator", "sample_tokens"]

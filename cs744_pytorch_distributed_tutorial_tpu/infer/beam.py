@@ -1,0 +1,141 @@
+"""Beam search decoding over the KV cache.
+
+Companion to ``infer/generate.py``'s sampling loop: deterministic
+highest-likelihood decoding. Same TPU-first shape discipline — the whole
+search is ONE jitted program (prefill + ``lax.scan``), every buffer
+static. Beam reordering (the data-dependent part) is expressed as
+``take``-gathers over the beam-flattened batch axis of the cache pytree,
+which XLA lowers to dynamic-gathers on device — no host round-trips.
+
+Layout: batch ``B`` and ``K`` beams flatten to a ``B*K`` "batch" for the
+model (flat index = b*K + k). Scores are accumulated log-probs; finished
+beams (emitted ``eos_id``) can only extend with ``pad_id`` at zero cost,
+so their scores freeze while live beams keep competing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def make_beam_searcher(
+    model: Any,
+    *,
+    beam_size: int,
+    max_new_tokens: int,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    length_penalty: float = 0.0,
+):
+    """Build a jitted ``search(params, prompt) -> (tokens, scores)``.
+
+    ``tokens`` is ``[B, max_new_tokens]`` — the best beam per batch row
+    after length normalization (``score / len**length_penalty``; 0.0 =
+    raw log-prob, higher values favor longer sequences). ``scores`` is
+    the selected beam's raw accumulated log-prob. Same model contract as
+    ``make_generator`` (``seq_axis=None``; params from any training mesh
+    drop in).
+    """
+    if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
+        raise ValueError("beam search needs a model with seq_axis=None")
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    K = beam_size
+
+    def search(params, prompt: jax.Array) -> tuple[jax.Array, jax.Array]:
+        b, t0 = prompt.shape
+        if t0 + max_new_tokens > model.max_seq_len:
+            raise ValueError(
+                f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
+                f"max_seq_len ({model.max_seq_len})"
+            )
+        logits, variables = model.apply(
+            {"params": params}, prompt, mode="prefill", mutable=["cache"]
+        )
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [B, V]
+        vocab = logp.shape[-1]
+        k_eff = min(K, vocab)
+
+        # First expansion: top-K tokens of the prompt's next-token dist.
+        scores, tok0 = lax.top_k(logp, k_eff)  # [B, K]
+        if k_eff < K:  # degenerate beam > vocab: pad with dead beams
+            scores = jnp.pad(scores, ((0, 0), (0, K - k_eff)), constant_values=_NEG)
+            tok0 = jnp.pad(tok0, ((0, 0), (0, K - k_eff)))
+
+        # Tile the cache to the beam-flattened batch: row b -> rows b*K..b*K+K-1.
+        cache = jax.tree.map(
+            lambda c: jnp.repeat(c, K, axis=0), variables["cache"]
+        )
+        seqs = jnp.full((b, K, max_new_tokens), pad_id, jnp.int32)
+        seqs = seqs.at[:, :, 0].set(tok0)
+        finished = (
+            (tok0 == eos_id) if eos_id is not None else jnp.zeros((b, K), bool)
+        )
+
+        # Continuation distribution for a finished beam: pad at zero cost.
+        pad_only = jnp.full((vocab,), _NEG).at[pad_id].set(0.0)
+
+        def body(carry, step):
+            cache, seqs, scores, finished, last_tok = carry
+            # ``last_tok`` was chosen at loop index ``step - 1`` and sits
+            # at global position t0 + step - 1.
+            pos = t0 + step - 1
+            step_logits, mutated = model.apply(
+                {"params": params, "cache": cache},
+                last_tok.reshape(b * K, 1),
+                mode="decode",
+                decode_pos=pos,
+                mutable=["cache"],
+            )
+            cache = mutated["cache"]
+            logp = jax.nn.log_softmax(
+                step_logits[:, 0].astype(jnp.float32)
+            ).reshape(b, K, vocab)
+            logp = jnp.where(finished[:, :, None], pad_only[None, None, :], logp)
+            total = scores[:, :, None] + logp  # [B, K, V]
+            new_scores, flat = lax.top_k(total.reshape(b, K * vocab), K)
+            parent = flat // vocab  # [B, K] beam index to continue
+            token = (flat % vocab).astype(jnp.int32)
+
+            # Reorder beam-indexed state by parent.
+            flat_parent = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
+            cache = jax.tree.map(lambda c: jnp.take(c, flat_parent, axis=0), cache)
+            seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
+            seqs = seqs.at[:, :, step].set(token)
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            if eos_id is not None:
+                finished = finished | (token == eos_id)
+            return (cache, seqs, new_scores, finished, token), None
+
+        carry = (cache, seqs, scores, finished, tok0)
+        if max_new_tokens > 1:
+            carry, _ = lax.scan(
+                body, carry, jnp.arange(1, max_new_tokens)
+            )
+        _, seqs, scores, finished, _ = carry
+
+        # Length-normalized selection: len = tokens up to and incl. EOS.
+        if eos_id is not None:
+            is_eos = seqs == eos_id
+            any_eos = is_eos.any(axis=-1)
+            first_eos = jnp.argmax(is_eos, axis=-1)
+            lengths = jnp.where(any_eos, first_eos + 1, max_new_tokens)
+        else:
+            lengths = jnp.full((b, K), max_new_tokens)
+        norm = scores / jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+        best = jnp.argmax(norm, axis=-1)  # [B]
+        best_seq = jnp.take_along_axis(
+            seqs, best[:, None, None], axis=1
+        ).squeeze(1)
+        best_score = jnp.take_along_axis(scores, best[:, None], axis=1).squeeze(1)
+        return best_seq, best_score
+
+    return jax.jit(search)

@@ -75,6 +75,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--beam", type=int, default=0, metavar="K",
+                   help="beam-search decode with K beams instead of sampling")
     p.add_argument("--json", action="store_true")
     return p
 
@@ -150,18 +152,28 @@ def main(argv: list[str] | None = None) -> int:
             )
         else:
             prompt_ids = tokens[:1, : args.prompt_len]
-        generate = make_generator(
-            trainer.decode_model(),
-            max_new_tokens=args.generate,
-            temperature=args.temperature,
-            top_k=args.top_k,
-            top_p=args.top_p,
-        )
-        out = generate(
-            jax.device_get(params),
-            np.asarray(prompt_ids, dtype=np.int32),
-            jax.random.key(args.seed),
-        )
+        host_params = jax.device_get(params)
+        prompt_arr = np.asarray(prompt_ids, dtype=np.int32)
+        if args.beam > 0:
+            from cs744_pytorch_distributed_tutorial_tpu.infer import (
+                make_beam_searcher,
+            )
+
+            search = make_beam_searcher(
+                trainer.decode_model(),
+                beam_size=args.beam,
+                max_new_tokens=args.generate,
+            )
+            out, _ = search(host_params, prompt_arr)
+        else:
+            generate = make_generator(
+                trainer.decode_model(),
+                max_new_tokens=args.generate,
+                temperature=args.temperature,
+                top_k=args.top_k,
+                top_p=args.top_p,
+            )
+            out = generate(host_params, prompt_arr, jax.random.key(args.seed))
         sample_ids = np.asarray(out)[0].tolist()
         if args.text_file:
             sample_text = bytes(sample_ids).decode("utf-8", errors="replace")

@@ -1,0 +1,110 @@
+"""Beam search (infer/beam.py): scores, greedy equivalence, EOS handling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.infer import (
+    make_beam_searcher,
+    make_generator,
+)
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+
+VOCAB = 37
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    model = TransformerLM(
+        vocab_size=VOCAB,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=32,
+        attention_impl="dense",
+    )
+    params = model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def _sequence_logprob(model, params, prompt, generated):
+    """Teacher-forced log-prob of ``generated`` given ``prompt``."""
+    full = jnp.concatenate([prompt, generated], axis=1)
+    logits = model.apply({"params": params}, full)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    t0 = prompt.shape[1]
+    total = 0.0
+    for i in range(generated.shape[1]):
+        # token at position t0+i is predicted from position t0+i-1
+        total += float(
+            logp[jnp.arange(full.shape[0]), t0 + i - 1, full[:, t0 + i]].sum()
+        )
+    return total
+
+
+def test_beam_1_equals_greedy(tiny_lm):
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(1), (2, 5), 0, VOCAB)
+    greedy = make_generator(model, max_new_tokens=6, temperature=0.0)
+    beam = make_beam_searcher(model, beam_size=1, max_new_tokens=6)
+    g = np.asarray(greedy(params, prompt, jax.random.key(0)))
+    b, _ = beam(params, prompt)
+    np.testing.assert_array_equal(g, np.asarray(b))
+
+
+def test_beam_score_is_model_logprob(tiny_lm):
+    """The returned score must equal the teacher-forced log-prob of the
+    returned sequence (no EOS involved) — pins the accumulation."""
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(2), (1, 5), 0, VOCAB)
+    beam = make_beam_searcher(model, beam_size=3, max_new_tokens=5)
+    seq, score = beam(params, prompt)
+    expected = _sequence_logprob(model, params, prompt, jnp.asarray(seq))
+    assert float(score[0]) == pytest.approx(expected, rel=1e-4, abs=1e-4)
+
+
+def test_wider_beam_never_worse(tiny_lm):
+    """Beam K's best raw score >= greedy's sequence log-prob (beam search
+    explores a superset of the greedy path)."""
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(3), (1, 4), 0, VOCAB)
+    b1 = make_beam_searcher(model, beam_size=1, max_new_tokens=6)
+    b4 = make_beam_searcher(model, beam_size=4, max_new_tokens=6)
+    _, s1 = b1(params, prompt)
+    _, s4 = b4(params, prompt)
+    assert float(s4[0]) >= float(s1[0]) - 1e-5
+
+
+def test_beam_eos_pads_tail(tiny_lm):
+    model, params = tiny_lm
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, VOCAB)
+    ref = make_beam_searcher(model, beam_size=2, max_new_tokens=6)
+    seq_ref, _ = ref(params, prompt)
+    eos = int(np.asarray(seq_ref)[0, 1])  # force an early EOS for row 0
+
+    pad = VOCAB + 3
+    beam = make_beam_searcher(
+        model, beam_size=2, max_new_tokens=6, eos_id=eos, pad_id=pad
+    )
+    seq, _ = beam(params, prompt)
+    for row in np.asarray(seq):
+        hits = np.flatnonzero(row == eos)
+        if hits.size:
+            assert (row[hits[0] + 1 :] == pad).all()
+
+
+def test_beam_batch_independence(tiny_lm):
+    """Each batch row's beam search is independent: searching rows
+    together == searching them alone."""
+    model, params = tiny_lm
+    prompts = jax.random.randint(jax.random.key(5), (3, 5), 0, VOCAB)
+    beam = make_beam_searcher(model, beam_size=3, max_new_tokens=4)
+    joint, joint_scores = beam(params, prompts)
+    for i in range(3):
+        solo, solo_score = beam(params, prompts[i : i + 1])
+        np.testing.assert_array_equal(np.asarray(joint)[i], np.asarray(solo)[0])
+        assert float(joint_scores[i]) == pytest.approx(
+            float(solo_score[0]), rel=1e-5
+        )
