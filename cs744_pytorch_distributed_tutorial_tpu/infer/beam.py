@@ -44,6 +44,11 @@ def make_beam_searcher(
     """
     if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
         raise ValueError("beam search needs a model with seq_axis=None")
+    if getattr(model, "tensor_axis", None) is not None and model.tensor_axis_size > 1:
+        raise ValueError(
+            "beam search does not run under tensor parallelism; construct a "
+            "decode copy with tensor_axis=None from gathered full params"
+        )
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
     if max_new_tokens < 1:
@@ -80,8 +85,12 @@ def make_beam_searcher(
             (tok0 == eos_id) if eos_id is not None else jnp.zeros((b, K), bool)
         )
 
-        # Continuation distribution for a finished beam: pad at zero cost.
-        pad_only = jnp.full((vocab,), _NEG).at[pad_id].set(0.0)
+        # Continuation distribution for a finished beam: exactly one
+        # candidate (slot 0) at zero cost, so the beam's score freezes.
+        # The emitted token is rewritten to pad_id after selection —
+        # pad_id may be out-of-vocab (an unmistakable sentinel), so it
+        # cannot be represented as a candidate index itself.
+        frozen = jnp.full((vocab,), _NEG).at[0].set(0.0)
 
         def body(carry, step):
             cache, seqs, scores, finished, last_tok = carry
@@ -99,18 +108,22 @@ def make_beam_searcher(
             logp = jax.nn.log_softmax(
                 step_logits[:, 0].astype(jnp.float32)
             ).reshape(b, K, vocab)
-            logp = jnp.where(finished[:, :, None], pad_only[None, None, :], logp)
+            logp = jnp.where(finished[:, :, None], frozen[None, None, :], logp)
             total = scores[:, :, None] + logp  # [B, K, V]
             new_scores, flat = lax.top_k(total.reshape(b, K * vocab), K)
             parent = flat // vocab  # [B, K] beam index to continue
             token = (flat % vocab).astype(jnp.int32)
+            # A finished parent's only candidate was the frozen slot;
+            # what it actually emits is padding.
+            parent_finished = jnp.take_along_axis(finished, parent, axis=1)
+            token = jnp.where(parent_finished, pad_id, token)
 
             # Reorder beam-indexed state by parent.
             flat_parent = (jnp.arange(b)[:, None] * K + parent).reshape(-1)
             cache = jax.tree.map(lambda c: jnp.take(c, flat_parent, axis=0), cache)
             seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
             seqs = seqs.at[:, :, step].set(token)
-            finished = jnp.take_along_axis(finished, parent, axis=1)
+            finished = parent_finished
             if eos_id is not None:
                 finished = finished | (token == eos_id)
             return (cache, seqs, new_scores, finished, token), None

@@ -139,6 +139,13 @@ def main(argv: list[str] | None = None) -> int:
 
     sample_text = None
     sample_ids = None
+    if args.beam > 0 and (
+        args.top_k is not None or args.top_p is not None or args.temperature != 1.0
+    ):
+        raise SystemExit(
+            "--beam is deterministic highest-likelihood decoding; it cannot "
+            "combine with --temperature/--top-k/--top-p (drop --beam to sample)"
+        )
     if args.generate > 0:
         from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
 

@@ -78,18 +78,25 @@ def test_wider_beam_never_worse(tiny_lm):
 
 
 def test_beam_eos_pads_tail(tiny_lm):
+    """A beam that emits EOS freezes its score; since every continuation
+    has negative log-prob, the frozen beam must win — and its tail must
+    be pad (including an out-of-vocab sentinel pad_id)."""
     model, params = tiny_lm
     prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, VOCAB)
     ref = make_beam_searcher(model, beam_size=2, max_new_tokens=6)
     seq_ref, _ = ref(params, prompt)
-    eos = int(np.asarray(seq_ref)[0, 1])  # force an early EOS for row 0
+    # EOS = row 0's FIRST token: its beam finishes immediately with the
+    # single-token score, which strictly dominates any longer sequence.
+    eos = int(np.asarray(seq_ref)[0, 0])
 
     pad = VOCAB + 3
     beam = make_beam_searcher(
         model, beam_size=2, max_new_tokens=6, eos_id=eos, pad_id=pad
     )
-    seq, _ = beam(params, prompt)
-    for row in np.asarray(seq):
+    seq = np.asarray(beam(params, prompt)[0])
+    assert seq[0, 0] == eos, "the immediately-finished beam must win row 0"
+    assert (seq[0, 1:] == pad).all()
+    for row in seq:
         hits = np.flatnonzero(row == eos)
         if hits.size:
             assert (row[hits[0] + 1 :] == pad).all()
