@@ -40,6 +40,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true")
     p.add_argument("--tie-embeddings", action="store_true",
                    help="share the token embedding with the output head")
+    p.add_argument("--fused-xent", action="store_true",
+                   help="Pallas fused softmax cross-entropy (ops/fused_xent.py)")
     # MoE
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=2)
@@ -116,6 +118,7 @@ def main(argv: list[str] | None = None) -> int:
         compute_dtype=args.compute_dtype,
         remat=args.remat,
         tie_embeddings=args.tie_embeddings,
+        fused_xent=args.fused_xent,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_expert_parallel=args.moe_expert_parallel,

@@ -94,6 +94,11 @@ class LMConfig:
     # lm_head (halves the vocab parameters).
     tie_embeddings: bool = False
 
+    # Pallas fused softmax-CE (ops/fused_xent.py): one pass over the
+    # logits instead of materializing the [N, V] log-softmax — the
+    # large-vocab loss lever. Interpret mode off-TPU.
+    fused_xent: bool = False
+
     # Gradient accumulation: split each device's batch shard into
     # ``accum_steps`` microbatches, run fwd/bwd per microbatch under
     # ``lax.scan`` (activations for only ONE microbatch live at a time —
@@ -194,6 +199,7 @@ class LMTrainer:
         # backend, which can differ on a TPU host driving a CPU mesh).
         platforms = {d.platform for d in self.mesh.devices.flat}
         flash_interpret = platforms.isdisjoint({"tpu", "axon"})
+        self._flash_interpret = flash_interpret
         self.model = TransformerLM(
             vocab_size=cfg.vocab_size,
             num_layers=cfg.num_layers,
@@ -327,6 +333,9 @@ class LMTrainer:
 
         accum = self.cfg.accum_steps
 
+        fused_xent = self.cfg.fused_xent
+        xent_interpret = self._flash_interpret
+
         def local_step(params, opt_state, tokens, targets):
             def loss_fn(p, toks, tgts):
                 # mutable=["losses"] collects each MoE layer's sown
@@ -334,9 +343,21 @@ class LMTrainer:
                 logits, mut = model.apply(
                     {"params": p}, toks, mutable=["losses"]
                 )
-                ce = optax.softmax_cross_entropy_with_integer_labels(
-                    logits, tgts
-                ).mean()
+                if fused_xent:
+                    from cs744_pytorch_distributed_tutorial_tpu.ops.fused_xent import (
+                        fused_cross_entropy,
+                    )
+
+                    v = logits.shape[-1]
+                    ce = fused_cross_entropy(
+                        logits.reshape(-1, v),
+                        tgts.reshape(-1),
+                        interpret=xent_interpret,
+                    ).mean()
+                else:
+                    ce = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, tgts
+                    ).mean()
                 from cs744_pytorch_distributed_tutorial_tpu.models.moe import (
                     moe_aux_loss,
                 )
