@@ -1,0 +1,70 @@
+"""LM training throughput on the real chip: tokens/sec, flash vs dense.
+
+Single-chip companion to the scored CIFAR bench: a GPT-style block stack
+at seq_len 2048 in bf16, comparing the Pallas flash-attention kernel
+(ops/flash_attention.py) against dense attention. Run: python
+benchmarks/bench_lm.py
+
+Measured 2026-07-30 (one TPU v5e chip, this config):
+  dense  92.3 ms/step  177.6k tokens/sec
+  flash  89.8 ms/step  182.4k tokens/sec
+Forward-only the kernel is 2.5x faster than dense (4.3 vs 10.7 ms after
+retuning blocks to 512x1024 — the old 128x128 default was 2x SLOWER);
+the full-step margin is small because the backward recomputes through
+the dense formulation either way (the next kernel to write).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+BATCH = 8
+SEQ = 2048
+STEPS = 10
+
+
+def main() -> None:
+    mesh = make_mesh({"data": 1, "seq": 1})
+    tokens = synthetic_tokens(BATCH * 2, SEQ, 32768, seed=0)
+    for impl in ("dense", "flash"):
+        cfg = LMConfig(
+            vocab_size=32768,
+            num_layers=4,
+            num_heads=8,
+            d_model=512,
+            d_ff=2048,
+            max_seq_len=SEQ,
+            seq_len=SEQ,
+            global_batch_size=BATCH,
+            attention_impl=impl,
+            compute_dtype="bfloat16",
+        )
+        tr = LMTrainer(cfg, mesh=mesh)
+        params, opt = tr.init()
+        x, y = tr.shard_batch(tokens[:BATCH])
+
+        params, opt, m = tr.train_step(params, opt, x, y)  # compile
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])  # fence (see bench.py on block_until_ready)
+        dt = (time.perf_counter() - t0) / STEPS
+        print(
+            f"{impl:6s} {dt * 1e3:8.2f} ms/step  "
+            f"{BATCH * SEQ / dt:12.0f} tokens/sec"
+        )
+
+
+if __name__ == "__main__":
+    main()

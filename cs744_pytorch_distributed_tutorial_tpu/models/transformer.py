@@ -175,7 +175,9 @@ class Attention(nn.Module):
                     if self.flash_interpret is not None
                     else default_flash_interpret()
                 )
-                out = flash_attention(q, k, v, self.causal, 128, 128, interpret)
+                out = flash_attention(
+                    q, k, v, self.causal, interpret=interpret
+                )
             else:
                 out = dense_attention(q, k, v, causal=self.causal)
         elif self.impl == "ring":

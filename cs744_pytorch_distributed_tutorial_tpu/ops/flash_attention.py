@@ -47,7 +47,10 @@ def _kernel(causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     t = k_ref.shape[1]
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    # Feed the MXU its native input dtype (bf16 stays bf16 — casting to
+    # f32 first would quarter the matmul rate); accumulate in f32 via
+    # preferred_element_type, scale afterwards (distributes).
+    q = q_ref[0]
 
     m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -55,11 +58,11 @@ def _kernel(causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref
 
     def body(kb, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k] f32
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
@@ -73,7 +76,8 @@ def _kernel(causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref
         p = jnp.exp(s - m_new)
         l_new = correction * l + p.sum(axis=-1, keepdims=True)
         acc_new = acc * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -110,11 +114,17 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool = False,
 ) -> jax.Array:
-    """Blockwise attention on [B, T, H, D] without the [T, T] matrix."""
+    """Blockwise attention on [B, T, H, D] without the [T, T] matrix.
+
+    Default blocks measured on TPU v5e (T=2048, D=64, bf16): (512, 1024)
+    runs 2.5x faster than XLA dense attention forward; the earlier
+    (128, 128) default was 2x SLOWER than dense — per-iteration VPU
+    overhead dominates small tiles. ``_pick_block`` shrinks to a divisor
+    for short sequences."""
     return _forward(q, k, v, causal, block_q, block_k, interpret)
 
 
