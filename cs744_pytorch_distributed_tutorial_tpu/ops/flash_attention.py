@@ -15,14 +15,15 @@ variant prunes the loop to blocks at or below the query block's
 diagonal. Softmax statistics accumulate in float32 regardless of input
 dtype (bfloat16 inputs hit the MXU; the normalizer stays full precision).
 
-Differentiation: ``jax.custom_vjp`` with a recompute backward — the
-forward is the Pallas kernel, the backward re-derives gradients through
-the mathematically identical dense formulation (standard
-kernel-forward/XLA-backward split; the backward's [T, T] materialization
-is acceptable because training at long T runs under ring attention,
-where per-chip T_local is small).
+Differentiation: ``jax.custom_vjp`` with Pallas kernels on BOTH sides
+(FlashAttention-2 style). The forward additionally emits the per-row
+logsumexp; the backward recomputes score tiles from (q, k, lse) and
+accumulates dq (grid over query blocks) and dk/dv (grid over key
+blocks) — nothing of [T, T] shape is materialized in either direction.
+The softmax-grad identity ``ds = p * (dp - rowsum(do*o))`` uses the
+delta vector computed once outside the kernel.
 
-``interpret=True`` runs the same kernel on any backend for tests.
+``interpret=True`` runs the same kernels on any backend for tests.
 """
 
 from __future__ import annotations
@@ -43,7 +44,10 @@ except Exception:  # pragma: no cover
 _NEG = -1e30
 
 
-def _kernel(causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref):
+def _kernel(
+    causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref,
+    lse_ref=None,
+):
     block_q, d = q_ref.shape[1], q_ref.shape[2]
     t = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -87,8 +91,10 @@ def _kernel(causal: bool, block_k: int, scale: float, q_ref, k_ref, v_ref, o_ref
         num_kb = (qi * block_q + block_q + block_k - 1) // block_k
     else:
         num_kb = t // block_k
-    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[0] = m + jnp.log(l)  # [block_q, 1]
 
 
 def _pick_block(t: int, preferred: int) -> int:
@@ -128,47 +134,213 @@ def flash_attention(
     return _forward(q, k, v, causal, block_q, block_k, interpret)
 
 
-def _forward(q, k, v, causal, block_q, block_k, interpret):
+def _to_bh(x, b, t, h, d):  # [B, T, H, D] -> [B*H, T, D]
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, t, h, d):
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _forward(q, k, v, causal, block_q, block_k, interpret, with_lse=False):
     b, t, h, d = q.shape
     block_q = _pick_block(t, block_q)
     block_k = _pick_block(t, block_k)
     scale = d**-0.5
 
-    def to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    qb, kb, vb = (_to_bh(x, b, t, h, d) for x in (q, k, v))
     spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0), **spec_kw)
     kv_spec = pl.BlockSpec((1, t, d), lambda bh, qi: (bh, 0, 0), **spec_kw)
+    # Row statistics ride as [BH, T, 1]: a trailing singleton keeps the
+    # last-two-dims (8, 128)-divisibility rule satisfiable at any block.
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda bh, qi: (bh, qi, 0), **spec_kw)
 
-    out = pl.pallas_call(
+    out_shapes = [jax.ShapeDtypeStruct(qb.shape, v.dtype)]
+    out_specs = [q_spec]
+    if with_lse:
+        out_shapes.append(jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32))
+        out_specs.append(row_spec)
+
+    res = pl.pallas_call(
         partial(_kernel, causal, block_k, scale),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, v.dtype),
+        out_shape=out_shapes,
         grid=(b * h, t // block_q),
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=q_spec,
+        out_specs=out_specs,
         interpret=interpret,
     )(qb, kb, vb)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = _from_bh(res[0], b, t, h, d)
+    return (out, res[1]) if with_lse else out
+
+
+def _dq_kernel(
+    causal, block_k, scale,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+):
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    t = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q, do = q_ref[0], do_ref[0]
+    lse = lse_ref[0]  # [bq, 1] f32
+    delta = delta_ref[0]
+
+    def body(kb, acc):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        p = jnp.exp(s - lse)  # masked entries underflow to 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        num_kb = (qi * block_q + block_q + block_k - 1) // block_k
+    else:
+        num_kb = t // block_k
+    acc = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    causal, block_q, scale,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+):
+    block_k, d = k_ref.shape[1], k_ref.shape[2]
+    t = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k, v = k_ref[0], v_ref[0]
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        p = jnp.exp(s - lse)
+        dv_new = dv_acc + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    if causal:
+        # Query blocks strictly above this key block's first row see none
+        # of it: start at the block containing that row.
+        start_qb = (ki * block_k) // block_q
+    else:
+        start_qb = 0
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        start_qb, t // block_q, body, (zeros, zeros)
+    )
+    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _forward(q, k, v, causal, block_q, block_k, interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
-    # Recompute backward through the canonical dense formulation — the
-    # exact semantics this kernel's forward reproduces, so the two can't
-    # drift apart.
-    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
-        dense_attention,
-    )
+    q, k, v, out, lse = residuals
+    b, t, h, d = q.shape
+    block_q = _pick_block(t, block_q)
+    block_k = _pick_block(t, block_k)
+    scale = d**-0.5
 
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: dense_attention(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    qb, kb, vb, ob, gb = (_to_bh(x, b, t, h, d) for x in (q, k, v, out, g))
+    # The softmax-grad row term: delta = rowsum(do * o) — O(T*D), no
+    # [T, T] shape, so plain XLA outside the kernels.
+    delta = jnp.sum(
+        gb.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [BH, T, 1]
+
+    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    bh = b * h
+
+    def full_spec(block):
+        return pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **spec_kw)
+
+    def tile_spec(block):
+        return pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0), **spec_kw)
+
+    full_row = pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0), **spec_kw)
+    tile_row_q = pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0), **spec_kw)
+
+    dq = pl.pallas_call(
+        partial(_dq_kernel, causal, block_k, scale),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        grid=(bh, t // block_q),
+        in_specs=[
+            tile_spec(block_q),  # q
+            full_spec(t),        # k
+            full_spec(t),        # v
+            tile_spec(block_q),  # do
+            tile_row_q,          # lse
+            tile_row_q,          # delta
+        ],
+        out_specs=tile_spec(block_q),
+        interpret=interpret,
+    )(qb, kb, vb, gb, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, causal, block_q, scale),
+        out_shape=[
+            jax.ShapeDtypeStruct(kb.shape, k.dtype),
+            jax.ShapeDtypeStruct(vb.shape, v.dtype),
+        ],
+        grid=(bh, t // block_k),
+        in_specs=[
+            full_spec(t),        # q
+            tile_spec(block_k),  # k
+            tile_spec(block_k),  # v
+            full_spec(t),        # do
+            full_row,            # lse
+            full_row,            # delta
+        ],
+        out_specs=[tile_spec(block_k), tile_spec(block_k)],
+        interpret=interpret,
+    )(qb, kb, vb, gb, lse, delta)
+
+    return tuple(_from_bh(x, b, t, h, d) for x in (dq, dk, dv))
 
 
 flash_attention.defvjp(_fwd, _bwd)
