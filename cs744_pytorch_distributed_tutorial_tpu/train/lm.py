@@ -115,6 +115,13 @@ class LMConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end when dir set
 
+    # Failure detection (utils/failure.py), same contract as the CIFAR
+    # engine: NaN/inf losses raise NonFiniteLossError (fit() fetches
+    # every loss anyway — zero extra transfers); step_timeout_s arms a
+    # hang watchdog around each step (first step exempt: XLA compile).
+    halt_on_nonfinite: bool = True
+    step_timeout_s: float | None = None
+
     def replace(self, **kw: Any) -> "LMConfig":
         return dataclasses.replace(self, **kw)
 
@@ -524,12 +531,35 @@ class LMTrainer:
         losses: list[float] = []
         n = len(tokens)
         b = cfg.global_batch_size
+        watchdog = None
+        if cfg.step_timeout_s:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+                StepWatchdog,
+            )
+
+            watchdog = StepWatchdog(cfg.step_timeout_s)
+        if cfg.halt_on_nonfinite:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+                NonFiniteLossError,
+            )
         try:
             for step in range(start_step, steps):
                 lo = (step * b) % max(n - b + 1, 1)
                 x, y = self.shard_batch(tokens[lo : lo + b])
-                params, opt_state, m = self.train_step(params, opt_state, x, y)
-                losses.append(float(m["loss"]))
+                # First executed step blocks on XLA compilation — exempt
+                # it from the watchdog (same policy as the CIFAR engine).
+                arm_now = watchdog is not None and step > start_step
+                if arm_now:
+                    watchdog.arm()
+                try:
+                    params, opt_state, m = self.train_step(params, opt_state, x, y)
+                    loss = float(m["loss"])
+                finally:
+                    if arm_now:
+                        watchdog.disarm()
+                if cfg.halt_on_nonfinite and not math.isfinite(loss):
+                    raise NonFiniteLossError(step, loss)
+                losses.append(loss)
                 if (
                     ckpt
                     and cfg.checkpoint_every
@@ -542,6 +572,8 @@ class LMTrainer:
                     LMState(jnp.int32(final), params, opt_state), force=True
                 )
         finally:
+            if watchdog is not None:
+                watchdog.close()
             if ckpt is not None:
                 ckpt.close()
         return params, opt_state, losses

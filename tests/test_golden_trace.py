@@ -31,3 +31,26 @@ def test_part3_loss_curve_matches_golden_trace(mesh4):
         data_seed=5000,
     )
     np.testing.assert_allclose(losses, GOLDEN, rtol=5e-3)
+
+
+# Long-context engine golden: ring attention on a 2x4 data x seq mesh,
+# AdamW lr 1e-2, synthetic cyclic tokens seed 5000. Pins the sequence-
+# parallel attention, offset position embeddings, spec-aware gradient
+# averaging, and the AdamW update in one curve.
+GOLDEN_LM = [4.61314, 4.38864, 4.223654, 4.082678, 4.278648, 4.134741,
+             4.185895, 4.089676]
+
+
+def test_lm_seq_parallel_loss_curve_matches_golden_trace():
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    cfg = LMConfig(vocab_size=64, num_layers=2, num_heads=4, d_model=64,
+                   d_ff=128, max_seq_len=256, seq_len=64, global_batch_size=8,
+                   attention_impl="ring", data_parallel=2, seq_parallel=4,
+                   learning_rate=1e-2, seed=5000)
+    tr = LMTrainer(cfg, mesh=make_mesh({"data": 2, "seq": 4}))
+    tokens = synthetic_tokens(64, cfg.seq_len, cfg.vocab_size, seed=5000)
+    _, _, losses = tr.fit(tokens, steps=len(GOLDEN_LM))
+    np.testing.assert_allclose(losses, GOLDEN_LM, rtol=5e-3)

@@ -1,0 +1,62 @@
+"""Failure detection on the LM engine (LMConfig.halt_on_nonfinite /
+step_timeout_s) — same contract as the CIFAR engine's suite."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+    NonFiniteLossError,
+)
+
+TINY = dict(vocab_size=32, num_layers=1, num_heads=2, d_model=16, d_ff=32,
+            max_seq_len=64, seq_len=16, global_batch_size=4,
+            attention_impl="ring", data_parallel=2, seq_parallel=2)
+
+
+def _nan_injecting(trainer, fail_at_call: int):
+    real = trainer.train_step
+    calls = {"n": 0}
+
+    def wrapped(params, opt_state, x, y):
+        p, o, m = real(params, opt_state, x, y)
+        calls["n"] += 1
+        if calls["n"] == fail_at_call:
+            m = dict(m, loss=jnp.float32(float("nan")))
+        return p, o, m
+
+    trainer.train_step = wrapped
+    return calls
+
+
+def test_lm_nan_loss_halts():
+    mesh = make_mesh({"data": 2, "seq": 2})
+    tr = LMTrainer(LMConfig(**TINY), mesh=mesh)
+    _nan_injecting(tr, fail_at_call=2)
+    tokens = synthetic_tokens(8, 16, 32, seed=0)
+    with pytest.raises(NonFiniteLossError) as ei:
+        tr.fit(tokens, steps=5)
+    assert ei.value.step == 1  # 0-indexed second step
+
+
+def test_lm_nan_ignored_when_disabled():
+    mesh = make_mesh({"data": 2, "seq": 2})
+    tr = LMTrainer(LMConfig(**TINY, halt_on_nonfinite=False), mesh=mesh)
+    _nan_injecting(tr, fail_at_call=2)
+    tokens = synthetic_tokens(8, 16, 32, seed=0)
+    _, _, losses = tr.fit(tokens, steps=4)
+    assert len(losses) == 4
+    assert np.isnan(losses[1])
+
+
+def test_lm_watchdog_runs_clean():
+    """A generous timeout never fires on a healthy run (and the thread
+    shuts down cleanly)."""
+    mesh = make_mesh({"data": 2, "seq": 2})
+    tr = LMTrainer(LMConfig(**TINY, step_timeout_s=120.0), mesh=mesh)
+    tokens = synthetic_tokens(8, 16, 32, seed=0)
+    _, _, losses = tr.fit(tokens, steps=3)
+    assert len(losses) == 3 and np.isfinite(losses).all()
