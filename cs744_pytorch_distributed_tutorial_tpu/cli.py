@@ -55,6 +55,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decay horizon for cosine schedules")
     p.add_argument("--grad-clip-norm", type=float, default=None,
                    help="clip the global gradient norm before the optimizer")
+    p.add_argument("--label-smoothing", type=float, default=None,
+                   help="smoothed CE target: (1-s) one-hot + s/num_classes")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-root", default=None)
     p.add_argument("--synthetic-data", action="store_true", default=None,
@@ -122,6 +124,7 @@ _ARG_TO_FIELD = {
     "warmup_steps": "warmup_steps",
     "total_steps": "total_steps",
     "grad_clip_norm": "grad_clip_norm",
+    "label_smoothing": "label_smoothing",
     "seed": "seed",
     "data_root": "data_root",
     "synthetic_data": "synthetic_data",

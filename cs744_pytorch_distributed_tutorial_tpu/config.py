@@ -70,6 +70,9 @@ class TrainConfig:
     # this value before the optimizer sees it; None disables. Capability
     # addition — the reference never clips.
     grad_clip_norm: float | None = None
+    # Label smoothing: target distribution (1-s) one-hot + s/num_classes.
+    # 0.0 reproduces the reference's plain CE (master/part1/part1.py:94).
+    label_smoothing: float = 0.0
 
     # Parallelism
     sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp

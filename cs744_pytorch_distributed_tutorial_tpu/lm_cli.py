@@ -56,6 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--grad-clip-norm", type=float, default=None)
+    p.add_argument("--label-smoothing", type=float, default=0.0)
     p.add_argument("--accum-steps", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
@@ -129,6 +130,7 @@ def main(argv: list[str] | None = None) -> int:
         seq_len=args.seq_len,
         learning_rate=args.lr,
         grad_clip_norm=args.grad_clip_norm,
+        label_smoothing=args.label_smoothing,
         accum_steps=args.accum_steps,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,

@@ -99,6 +99,10 @@ class LMConfig:
     # large-vocab loss lever. Interpret mode off-TPU.
     fused_xent: bool = False
 
+    # Label smoothing: (1-s) one-hot + s/vocab target; 0.0 = plain CE.
+    # Incompatible with fused_xent (the kernel computes plain CE).
+    label_smoothing: float = 0.0
+
     # Gradient accumulation: split each device's batch shard into
     # ``accum_steps`` microbatches, run fwd/bwd per microbatch under
     # ``lax.scan`` (activations for only ONE microbatch live at a time —
@@ -342,6 +346,16 @@ class LMTrainer:
 
         fused_xent = self.cfg.fused_xent
         xent_interpret = self._flash_interpret
+        smoothing = self.cfg.label_smoothing
+        if not 0.0 <= smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in [0, 1), got {smoothing}"
+            )
+        if smoothing and fused_xent:
+            raise ValueError(
+                "label_smoothing is incompatible with fused_xent: the Pallas "
+                "kernel computes plain CE"
+            )
 
         def local_step(params, opt_state, tokens, targets):
             def loss_fn(p, toks, tgts):
@@ -362,9 +376,11 @@ class LMTrainer:
                         interpret=xent_interpret,
                     ).mean()
                 else:
-                    ce = optax.softmax_cross_entropy_with_integer_labels(
-                        logits, tgts
-                    ).mean()
+                    from cs744_pytorch_distributed_tutorial_tpu.train.engine import (
+                        _smoothed_xent,
+                    )
+
+                    ce = _smoothed_xent(logits, tgts, smoothing)
                 from cs744_pytorch_distributed_tutorial_tpu.models.moe import (
                     moe_aux_loss,
                 )

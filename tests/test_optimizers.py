@@ -143,6 +143,25 @@ def test_grad_clip_trains_distributed(mesh4):
     ), "a binding clip bound should change the trajectory"
 
 
+def test_label_smoothing_trains_and_validates(mesh4):
+    losses, _, _ = run_tiny_dp4_steps(
+        "allreduce", mesh4, cfg_overrides={"label_smoothing": 0.1}
+    )
+    assert np.isfinite(losses).all()
+    with pytest.raises(ValueError, match="label_smoothing"):
+        Trainer(TrainConfig(**TINY_DP4_CFG, label_smoothing=1.5), mesh=mesh4)
+
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    with pytest.raises(ValueError, match="fused_xent"):
+        LMTrainer(
+            LMConfig(vocab_size=32, num_layers=1, num_heads=2, d_model=16,
+                     d_ff=32, max_seq_len=32, seq_len=16, global_batch_size=4,
+                     label_smoothing=0.1, fused_xent=True),
+            mesh=None,
+        )
+
+
 def test_sharded_optimizers_reject_custom_recipe(mesh4):
     """zero1/fsdp/fused hard-code the reference SGD update; the registry
     knobs must be rejected loudly, not silently ignored."""

@@ -132,6 +132,21 @@ def test_cross_entropy_matches_torch():
     np.testing.assert_allclose(float(ol), float(tl), rtol=1e-6)
 
 
+def test_label_smoothing_matches_torch():
+    """_smoothed_xent == torch CrossEntropyLoss(label_smoothing=s)."""
+    from cs744_pytorch_distributed_tutorial_tpu.train.engine import _smoothed_xent
+
+    rng = np.random.default_rng(11)
+    logits = rng.standard_normal((16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, 16)
+    for s in (0.0, 0.1, 0.3):
+        tl = torch.nn.CrossEntropyLoss(label_smoothing=s)(
+            torch.tensor(logits), torch.tensor(labels, dtype=torch.long)
+        )
+        ol = _smoothed_xent(jnp.asarray(logits), jnp.asarray(labels), s)
+        np.testing.assert_allclose(float(ol), float(tl), rtol=1e-5)
+
+
 def test_attention_matches_torch_sdpa():
     """Our dense causal attention == torch's canonical
     scaled_dot_product_attention(is_causal=True) on shared projection
