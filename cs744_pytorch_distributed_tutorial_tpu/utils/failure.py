@@ -169,6 +169,7 @@ def run_with_recovery(
     trainer: Any,
     *,
     max_restarts: int = 2,
+    fit_args: tuple = (),
     fit_kwargs: dict[str, Any] | None = None,
 ):
     """Run ``trainer.fit`` with checkpoint/restart recovery.
@@ -181,7 +182,10 @@ def run_with_recovery(
     ``trainer.cfg.checkpoint_dir`` (without it there is nothing to
     restart FROM, and the failure re-raises immediately).
 
-    Returns ``(state, history, restarts)``.
+    Works with either engine — the CIFAR ``Trainer`` (``fit()`` ->
+    ``(state, history)``) or ``LMTrainer`` (``fit(tokens, steps)`` ->
+    ``(params, opt_state, losses)``): returns ``fit``'s tuple with
+    ``restarts`` appended.
     """
     log = get_logger()
     if not getattr(trainer.cfg, "checkpoint_dir", None):
@@ -193,8 +197,8 @@ def run_with_recovery(
     restarts = 0
     while True:
         try:
-            state, history = trainer.fit(**kwargs)
-            return state, history, restarts
+            result = trainer.fit(*fit_args, **kwargs)
+            return (*result, restarts)
         except TrainingFailure as e:
             restarts += 1
             if restarts > max_restarts:

@@ -52,6 +52,37 @@ def test_lm_nan_ignored_when_disabled():
     assert np.isnan(losses[1])
 
 
+def test_lm_run_with_recovery_restarts_from_checkpoint(tmp_path):
+    """A transient NaN triggers one restart; fit resumes from the
+    checkpoint and completes all steps."""
+    from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+        run_with_recovery,
+    )
+
+    mesh = make_mesh({"data": 2, "seq": 2})
+    tr = LMTrainer(
+        LMConfig(**TINY, checkpoint_dir=str(tmp_path), checkpoint_every=1),
+        mesh=mesh,
+    )
+    real = tr.train_step
+    calls = {"n": 0}
+
+    def flaky(params, opt_state, x, y):
+        p, o, m = real(params, opt_state, x, y)
+        calls["n"] += 1
+        if calls["n"] == 3:  # transient: fails once, clean on replay
+            m = dict(m, loss=jnp.float32(float("inf")))
+        return p, o, m
+
+    tr.train_step = flaky
+    tokens = synthetic_tokens(8, 16, 32, seed=0)
+    params, opt, losses, restarts = run_with_recovery(
+        tr, fit_args=(tokens, 4), max_restarts=2
+    )
+    assert restarts == 1
+    assert np.isfinite(losses).all()
+
+
 def test_lm_watchdog_runs_clean():
     """A generous timeout never fires on a healthy run (and the thread
     shuts down cleanly)."""
