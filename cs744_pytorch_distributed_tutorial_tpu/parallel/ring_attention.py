@@ -122,7 +122,9 @@ def ring_attention(
     scale = d**-0.5
     up = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    qf = q.astype(jnp.float32)
+    # K/V rotate and contract in their native dtype (bf16 rides the MXU
+    # at full rate — an f32 pre-cast would quarter it AND double the ICI
+    # bytes per hop); accumulators stay float32.
     m0 = jnp.full((b, h, t_local), _MASK, jnp.float32)
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
@@ -133,7 +135,7 @@ def ring_attention(
         k_off = ((idx - s) % axis_size) * t_local
         scores = (
             jnp.einsum(
-                "bqhd,bkhd->bhqk", qf, kb, preferred_element_type=jnp.float32
+                "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
             )
             * scale
         )
@@ -146,7 +148,8 @@ def ring_attention(
         p = jnp.exp(scores - m_new[..., None])
         l_new = correction * l + p.sum(axis=-1)
         pv = jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vb, preferred_element_type=jnp.float32
+            "bhqk,bkhd->bqhd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
         )
         o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
         # Rotate K/V one neighbor up the ring (skip the final dead hop).
@@ -160,8 +163,7 @@ def ring_attention(
         )
         return kb, vb, m_new, l_new, o_new
 
-    kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
-    _, _, _, l, o = lax.fori_loop(0, axis_size, step, (kf, vf, m0, l0, o0))
+    _, _, _, l, o = lax.fori_loop(0, axis_size, step, (k, v, m0, l0, o0))
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(v.dtype)
 
