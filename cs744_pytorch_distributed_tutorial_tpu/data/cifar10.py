@@ -19,6 +19,11 @@ import numpy as np
 _BATCH_DIR = "cifar-10-batches-py"
 _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
 _TEST_FILE = "test_batch"
+# The official BINARY distribution (cifar-10-binary.tar.gz): 3073-byte
+# records, decoded by the native C++ core (native/decode.cpp).
+_BIN_DIR = "cifar-10-batches-bin"
+_BIN_TRAIN_FILES = [f"data_batch_{i}.bin" for i in range(1, 6)]
+_BIN_TEST_FILE = "test_batch.bin"
 NUM_CLASSES = 10
 
 
@@ -42,6 +47,14 @@ def _read_batch(path: str) -> tuple[np.ndarray, np.ndarray]:
     images = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     labels = np.asarray(d[b"labels"], dtype=np.int32)
     return images, labels
+
+
+def _read_binary_batch(path: str) -> tuple[np.ndarray, np.ndarray]:
+    from cs744_pytorch_distributed_tutorial_tpu.data.native_decode import (
+        decode_cifar_records,
+    )
+
+    return decode_cifar_records(np.fromfile(path, dtype=np.uint8))
 
 
 def synthetic_images(
@@ -114,9 +127,14 @@ def load_cifar10(
     """
     cifar_shaped = image_size == 32 and num_classes == NUM_CLASSES
     batch_dir = os.path.join(root, _BATCH_DIR)
-    have_real = cifar_shaped and all(
+    bin_dir = os.path.join(root, _BIN_DIR)
+    have_pickle = cifar_shaped and all(
         os.path.exists(os.path.join(batch_dir, f))
         for f in _TRAIN_FILES + [_TEST_FILE]
+    )
+    have_binary = cifar_shaped and all(
+        os.path.exists(os.path.join(bin_dir, f))
+        for f in _BIN_TRAIN_FILES + [_BIN_TEST_FILE]
     )
     if synthetic is False and not cifar_shaped:
         raise ValueError(
@@ -124,7 +142,9 @@ def load_cifar10(
             f"image_size={image_size}, num_classes={num_classes} with "
             "synthetic=False"
         )
-    if synthetic is True or (synthetic is None and not have_real):
+    if synthetic is True or (
+        synthetic is None and not (have_pickle or have_binary)
+    ):
         return synthetic_images(
             synthetic_train_size,
             synthetic_test_size,
@@ -132,16 +152,24 @@ def load_cifar10(
             num_classes=num_classes,
             seed=seed,
         )
-    if not have_real:
+    if not (have_pickle or have_binary):
         raise FileNotFoundError(
-            f"CIFAR-10 pickle batches not found under {batch_dir!r} and "
-            "synthetic=False. Place the 'cifar-10-batches-py' directory there "
-            "(the torchvision download layout)."
+            f"CIFAR-10 batches not found under {batch_dir!r} (pickle layout) "
+            f"or {bin_dir!r} (binary layout) and synthetic=False. Place "
+            "either distribution there."
         )
-    train_parts = [_read_batch(os.path.join(batch_dir, f)) for f in _TRAIN_FILES]
+    if have_pickle:
+        read, train_files, test_file, d = (
+            _read_batch, _TRAIN_FILES, _TEST_FILE, batch_dir
+        )
+    else:
+        read, train_files, test_file, d = (
+            _read_binary_batch, _BIN_TRAIN_FILES, _BIN_TEST_FILE, bin_dir
+        )
+    train_parts = [read(os.path.join(d, f)) for f in train_files]
     train_images = np.concatenate([p[0] for p in train_parts])
     train_labels = np.concatenate([p[1] for p in train_parts])
-    test_images, test_labels = _read_batch(os.path.join(batch_dir, _TEST_FILE))
+    test_images, test_labels = read(os.path.join(d, test_file))
     return CIFAR10Dataset(
         train_images, train_labels, test_images, test_labels, synthetic=False
     )

@@ -47,6 +47,54 @@ def test_gather_falls_back_for_unsupported_dtype():
     )
 
 
+def test_native_decoder_builds_and_matches_numpy():
+    """The C++ CIFAR binary decoder must compile here and agree with the
+    NumPy transpose on random records."""
+    from cs744_pytorch_distributed_tutorial_tpu.data.native_decode import (
+        RECORD_BYTES,
+        decode_cifar_records,
+    )
+
+    assert native_available("decode")
+    rng = np.random.default_rng(3)
+    n = 500  # > 1 MiB total: exercises the threaded path
+    raw = rng.integers(0, 256, size=n * RECORD_BYTES).astype(np.uint8)
+    images, labels = decode_cifar_records(raw)
+
+    recs = raw.reshape(n, RECORD_BYTES)
+    np.testing.assert_array_equal(labels, recs[:, 0].astype(np.int32))
+    expect = recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
+    np.testing.assert_array_equal(images, expect)
+
+    with pytest.raises(ValueError, match="multiple"):
+        decode_cifar_records(raw[:-1])
+
+
+def test_load_cifar10_reads_binary_layout(tmp_path):
+    """The official binary distribution round-trips through load_cifar10
+    via the native decoder."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import load_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.data.native_decode import (
+        RECORD_BYTES,
+    )
+
+    rng = np.random.default_rng(4)
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    per_file = 20
+    for name in [f"data_batch_{i}.bin" for i in range(1, 6)] + ["test_batch.bin"]:
+        recs = rng.integers(0, 256, size=(per_file, RECORD_BYTES)).astype(np.uint8)
+        recs[:, 0] = rng.integers(0, 10, size=per_file)  # valid labels
+        (d / name).write_bytes(recs.tobytes())
+
+    ds = load_cifar10(str(tmp_path), synthetic=False)
+    assert not ds.synthetic
+    assert ds.train_images.shape == (100, 32, 32, 3)
+    assert ds.test_images.shape == (20, 32, 32, 3)
+    assert ds.train_labels.dtype == np.int32
+    assert ds.train_labels.max() < 10
+
+
 def test_prefetch_preserves_order_and_values():
     items = list(range(50))
     assert list(prefetch(iter(items), depth=4)) == items
