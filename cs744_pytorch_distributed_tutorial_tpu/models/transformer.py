@@ -38,7 +38,7 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.tensor import (
     reduce_from_tp_region,
 )
 
-ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses")
+ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses", "ulysses_flash")
 
 
 def default_flash_interpret() -> bool:
@@ -162,19 +162,19 @@ class Attention(nn.Module):
                 )
                 decode_step = True
 
+        interpret = (
+            self.flash_interpret
+            if self.flash_interpret is not None
+            else default_flash_interpret()
+        )
         if decode_step:
             out = decode_attention(q, ck.value, cv.value, decode_pos)
         elif self.seq_axis is None or self.seq_axis_size == 1:
-            if self.impl == "flash":
+            if self.impl in ("flash", "ulysses_flash"):
                 from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
                     flash_attention,
                 )
 
-                interpret = (
-                    self.flash_interpret
-                    if self.flash_interpret is not None
-                    else default_flash_interpret()
-                )
                 out = flash_attention(
                     q, k, v, self.causal, interpret=interpret
                 )
@@ -184,15 +184,17 @@ class Attention(nn.Module):
             out = ring_attention(
                 q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
             )
-        elif self.impl == "ulysses":
+        elif self.impl in ("ulysses", "ulysses_flash"):
             out = ulysses_attention(
-                q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
+                q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal,
+                inner="flash" if self.impl == "ulysses_flash" else "dense",
+                flash_interpret=interpret,
             )
         else:  # dense/flash on a sequence-sharded axis
             raise ValueError(
                 f"impl={self.impl!r} cannot run on a sequence-sharded axis "
-                "(no communication to see the full sequence); use 'ring' or "
-                "'ulysses', or set seq_axis=None"
+                "(no communication to see the full sequence); use 'ring', "
+                "'ulysses', or 'ulysses_flash', or set seq_axis=None"
             )
         out = out.reshape(b, t, heads_local * head_dim).astype(self.dtype)
         out = nn.Dense(d_model, use_bias=False, dtype=self.dtype, name="attn_out")(

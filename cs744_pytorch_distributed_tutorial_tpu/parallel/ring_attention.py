@@ -176,18 +176,41 @@ def ulysses_attention(
     axis_size: int,
     *,
     causal: bool = False,
+    inner: str = "dense",
+    flash_interpret: bool = False,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern).
 
     Call under ``shard_map`` with [B, T_local, H, D] inputs. One
     ``all_to_all`` turns the sequence sharding into a *head* sharding
-    (every device sees the FULL sequence for H/axis_size heads), dense
-    attention runs locally — exact, no blockwise accumulation — and a
-    second ``all_to_all`` restores the sequence sharding. Two collectives
-    per attention call vs. the ring's axis_size-1 hops.
+    (every device sees the FULL sequence for H/axis_size heads), full
+    attention runs locally, and a second ``all_to_all`` restores the
+    sequence sharding. Two collectives per attention call vs. the ring's
+    axis_size-1 hops.
+
+    ``inner`` picks the local attention: ``"dense"`` (exact, [T, T]
+    materialized) or ``"flash"`` — the Pallas kernel
+    (``ops/flash_attention.py``), valid here because each head group
+    sees the FULL sequence starting at position 0, so no offset masking
+    is needed. The on-chip/between-chip composition: all_to_all moves
+    the data, the kernel does the math.
     """
+    if inner not in ("dense", "flash"):
+        raise ValueError(f"unknown inner attention {inner!r}")
+
+    def local_attention(qg, kg, vg):
+        if inner == "flash":
+            from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
+                flash_attention,
+            )
+
+            return flash_attention(
+                qg, kg, vg, causal, interpret=flash_interpret
+            )
+        return dense_attention(qg, kg, vg, causal=causal)
+
     if axis_size == 1:
-        return dense_attention(q, k, v, causal=causal)
+        return local_attention(q, k, v)
     h = q.shape[2]
     if h % axis_size:
         raise ValueError(
@@ -206,5 +229,5 @@ def ulysses_attention(
         )
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = dense_attention(qg, kg, vg, causal=causal)  # full seq, head group
+    out = local_attention(qg, kg, vg)  # full seq, head group
     return heads_to_seq(out)

@@ -60,7 +60,7 @@ class LMConfig:
     d_model: int = 128
     d_ff: int = 512
     max_seq_len: int = 2048
-    attention_impl: str = "ring"  # ring | ulysses | dense | flash (single-device)
+    attention_impl: str = "ring"  # ring | ulysses | ulysses_flash | dense | flash
     compute_dtype: str = "float32"  # "bfloat16" on real TPU runs
 
     data_parallel: int = 1
@@ -185,7 +185,10 @@ class LMTrainer:
                 f"d_ff {cfg.d_ff} not divisible by tensor axis {self.tensor_size}"
             )
         heads_local = cfg.num_heads // self.tensor_size
-        if cfg.attention_impl == "ulysses" and heads_local % self.seq_size:
+        if (
+            cfg.attention_impl in ("ulysses", "ulysses_flash")
+            and heads_local % self.seq_size
+        ):
             raise ValueError(
                 f"ulysses needs per-tensor-shard heads ({heads_local}) divisible "
                 f"by the seq axis ({self.seq_size})"

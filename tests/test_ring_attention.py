@@ -58,6 +58,48 @@ def test_ulysses_matches_dense(mesh8, qkv, causal):
     np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_flash_inner_matches_dense(mesh8, qkv, causal):
+    """The all-to-all + Pallas-flash composition: sequence parallelism
+    moves the data, the kernel does the math — same answer as dense."""
+    q, k, v = qkv
+    expected = np.asarray(dense_attention(q, k, v, causal=causal))
+    got = _run_sharded(
+        mesh8,
+        lambda a, b, c, ax, n: ulysses_attention(
+            a, b, c, ax, n, causal=causal, inner="flash", flash_interpret=True
+        ),
+        q, k, v,
+    )
+    np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_flash_lm_trains():
+    """attention_impl='ulysses_flash' end to end on a data x seq mesh."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    cfg = LMConfig(vocab_size=64, num_layers=1, num_heads=4, d_model=32,
+                   d_ff=64, max_seq_len=64, seq_len=32, global_batch_size=4,
+                   attention_impl="ulysses_flash",
+                   data_parallel=2, seq_parallel=2)
+    tr = LMTrainer(cfg, mesh=make_mesh({"data": 2, "seq": 2}))
+    tokens = synthetic_tokens(8, 32, 64, seed=0)
+    params, _, losses = tr.fit(tokens, steps=2)
+    assert np.isfinite(losses).all()
+
+    # Loss agrees with the plain-ulysses impl on the same init.
+    cfg2 = cfg.replace(attention_impl="ulysses")
+    tr2 = LMTrainer(cfg2, mesh=make_mesh({"data": 2, "seq": 2}))
+    p1, _ = tr.init()
+    p2, _ = tr2.init()
+    x, y = tr.shard_batch(tokens[:4])
+    l1 = float(tr.eval_step(p1, x, y)["loss"])
+    l2 = float(tr2.eval_step(p2, x, y)["loss"])
+    assert l1 == pytest.approx(l2, rel=1e-5)
+
+
 def test_ring_gradients_match_dense(mesh4, qkv):
     """Backward through the ring (ppermute transposes to the reverse
     ring) must agree with dense attention's gradients."""
