@@ -33,8 +33,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--d-model", type=int, default=256)
     p.add_argument("--d-ff", type=int, default=1024)
     p.add_argument("--max-seq-len", type=int, default=2048)
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+        ATTENTION_IMPLS,
+    )
+
     p.add_argument("--attention-impl", default="ring",
-                   choices=["ring", "ulysses", "dense", "flash"])
+                   choices=list(ATTENTION_IMPLS))
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--remat", action="store_true")

@@ -172,8 +172,8 @@ class LMTrainer:
             raise ValueError(
                 f"attention_impl={cfg.attention_impl!r} is incompatible with "
                 "seq_parallel > 1 (a sequence-sharded block cannot attend to "
-                "the full sequence without communication); use 'ring' or "
-                "'ulysses'"
+                "the full sequence without communication); use 'ring', "
+                "'ulysses', or 'ulysses_flash'"
             )
         if cfg.num_heads % self.tensor_size:
             raise ValueError(
