@@ -126,6 +126,13 @@ class LMConfig:
     halt_on_nonfinite: bool = True
     step_timeout_s: float | None = None
 
+    # Profiler capture (utils/profiling.py), same contract as the CIFAR
+    # engine: trace steps [profile_start_step, + profile_num_steps) to
+    # profile_dir. Start defaults past step 0 to keep compile out.
+    profile_dir: str | None = None
+    profile_start_step: int = 2
+    profile_num_steps: int = 3
+
     def replace(self, **kw: Any) -> "LMConfig":
         return dataclasses.replace(self, **kw)
 
@@ -561,10 +568,29 @@ class LMTrainer:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
                 NonFiniteLossError,
             )
+        profiling_active = False
+
+        def stop_profile() -> None:
+            nonlocal profiling_active
+            if profiling_active:
+                # fit() fetches every loss, so the traced steps' device
+                # work is already fenced when we get here.
+                jax.profiler.stop_trace()
+                profiling_active = False
+
         try:
             for step in range(start_step, steps):
                 lo = (step * b) % max(n - b + 1, 1)
                 x, y = self.shard_batch(tokens[lo : lo + b])
+                if (
+                    cfg.profile_dir
+                    and not profiling_active
+                    and cfg.profile_start_step
+                    <= step
+                    < cfg.profile_start_step + cfg.profile_num_steps
+                ):
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling_active = True
                 # First executed step blocks on XLA compilation — exempt
                 # it from the watchdog (same policy as the CIFAR engine).
                 arm_now = watchdog is not None and step > start_step
@@ -576,6 +602,11 @@ class LMTrainer:
                 finally:
                     if arm_now:
                         watchdog.disarm()
+                if (
+                    profiling_active
+                    and step + 1 >= cfg.profile_start_step + cfg.profile_num_steps
+                ):
+                    stop_profile()
                 if cfg.halt_on_nonfinite and not math.isfinite(loss):
                     raise NonFiniteLossError(step, loss)
                 losses.append(loss)
@@ -591,6 +622,7 @@ class LMTrainer:
                     LMState(jnp.int32(final), params, opt_state), force=True
                 )
         finally:
+            stop_profile()  # exception path: close any open capture
             if watchdog is not None:
                 watchdog.close()
             if ckpt is not None:

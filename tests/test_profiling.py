@@ -32,6 +32,29 @@ def test_fit_captures_profile_trace(mesh4, tmp_path):
     assert hits, f"no profiler output under {profile_dir}"
 
 
+def test_lm_fit_captures_profile_trace(tmp_path):
+    """Same contract on the LM engine (LMConfig.profile_dir)."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    profile_dir = str(tmp_path / "lm_trace")
+    cfg = LMConfig(vocab_size=32, num_layers=1, num_heads=2, d_model=16,
+                   d_ff=32, max_seq_len=32, seq_len=16, global_batch_size=4,
+                   attention_impl="ring", data_parallel=2, seq_parallel=2,
+                   profile_dir=profile_dir, profile_start_step=1,
+                   profile_num_steps=2)
+    tr = LMTrainer(cfg, mesh=make_mesh({"data": 2, "seq": 2}))
+    _, _, losses = tr.fit(synthetic_tokens(8, 16, 32, seed=0), steps=4)
+    assert len(losses) == 4
+    hits = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(profile_dir)
+        for f in files
+    ]
+    assert hits, f"no profiler output under {profile_dir}"
+
+
 def test_fit_profile_window_past_end_is_noop(mesh4, tmp_path):
     """A window that never opens (start beyond the run) must not trace or
     error."""
