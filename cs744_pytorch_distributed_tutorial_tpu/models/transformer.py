@@ -31,6 +31,7 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
     decode_attention,
     dense_attention,
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 from cs744_pytorch_distributed_tutorial_tpu.parallel.tensor import (
@@ -38,7 +39,9 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.tensor import (
     reduce_from_tp_region,
 )
 
-ATTENTION_IMPLS = ("dense", "flash", "ring", "ulysses", "ulysses_flash")
+ATTENTION_IMPLS = (
+    "dense", "flash", "ring", "ring_flash", "ulysses", "ulysses_flash"
+)
 
 
 def default_flash_interpret() -> bool:
@@ -170,7 +173,7 @@ class Attention(nn.Module):
         if decode_step:
             out = decode_attention(q, ck.value, cv.value, decode_pos)
         elif self.seq_axis is None or self.seq_axis_size == 1:
-            if self.impl in ("flash", "ulysses_flash"):
+            if self.impl in ("flash", "ring_flash", "ulysses_flash"):
                 from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
                     flash_attention,
                 )
@@ -183,6 +186,11 @@ class Attention(nn.Module):
         elif self.impl == "ring":
             out = ring_attention(
                 q, k, v, self.seq_axis, self.seq_axis_size, causal=self.causal
+            )
+        elif self.impl == "ring_flash":
+            out = ring_flash_attention(
+                q, k, v, self.seq_axis, self.seq_axis_size, self.causal,
+                interpret,
             )
         elif self.impl in ("ulysses", "ulysses_flash"):
             out = ulysses_attention(

@@ -274,6 +274,89 @@ def _dkv_kernel(
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
+def flash_forward_lse(
+    q, k, v, causal=False, block_q=512, block_k=1024, interpret=False
+):
+    """Non-differentiable forward primitive returning ``(out, lse)`` with
+    ``lse`` as ``[B*H, T, 1]`` float32 — the building block composite
+    attentions (``parallel/ring_attention.py::ring_flash_attention``)
+    merge across partial key sets. Differentiate the composite with its
+    own custom_vjp, not through this."""
+    return _forward(q, k, v, causal, block_q, block_k, interpret, with_lse=True)
+
+
+def flash_delta(out, g):
+    """The softmax-grad row term delta = rowsum(do * o) as [B*H, T, 1]
+    float32 — O(T*D), no [T, T] shape, plain XLA."""
+    b, t, h, d = out.shape
+    ob, gb = _to_bh(out, b, t, h, d), _to_bh(g, b, t, h, d)
+    return jnp.sum(
+        gb.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+
+def flash_dq(
+    q, k, v, do, lse, delta, causal, block_q=512, block_k=1024, interpret=False
+):
+    """dq for attention of ``q`` [B,Tq,H,D] against keys ``k``/``v``
+    [B,Tk,H,D], given the FINAL per-row ``lse``/``delta`` [B*H,Tq,1].
+    With an lse computed over a superset of these keys (a merged
+    multi-block softmax), this yields exactly this key-set's additive
+    contribution to dq."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = _pick_block(tq, block_q)
+    block_k = _pick_block(tk, block_k)
+    scale = d**-0.5
+    qb, kb, vb, gb = (
+        _to_bh(x, b, x.shape[1], h, d) for x in (q, k, v, do)
+    )
+    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    q_tile = pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0), **spec_kw)
+    kv_full = pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0), **spec_kw)
+    row_tile = pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0), **spec_kw)
+    dq = pl.pallas_call(
+        partial(_dq_kernel, causal, block_k, scale),
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        grid=(b * h, tq // block_q),
+        in_specs=[q_tile, kv_full, kv_full, q_tile, row_tile, row_tile],
+        out_specs=q_tile,
+        interpret=interpret,
+    )(qb, kb, vb, gb, lse, delta)
+    return _from_bh(dq, b, tq, h, d)
+
+
+def flash_dkv(
+    q, k, v, do, lse, delta, causal, block_q=512, block_k=1024, interpret=False
+):
+    """(dk, dv) for keys ``k``/``v`` [B,Tk,H,D] under queries ``q``
+    [B,Tq,H,D] with FINAL ``lse``/``delta`` [B*H,Tq,1] (see flash_dq)."""
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = _pick_block(tq, block_q)
+    block_k = _pick_block(tk, block_k)
+    scale = d**-0.5
+    qb, kb, vb, gb = (
+        _to_bh(x, b, x.shape[1], h, d) for x in (q, k, v, do)
+    )
+    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    q_full = pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0), **spec_kw)
+    k_tile = pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0), **spec_kw)
+    row_full = pl.BlockSpec((1, tq, 1), lambda i, j: (i, 0, 0), **spec_kw)
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, causal, block_q, scale),
+        out_shape=[
+            jax.ShapeDtypeStruct(kb.shape, k.dtype),
+            jax.ShapeDtypeStruct(vb.shape, v.dtype),
+        ],
+        grid=(b * h, tk // block_k),
+        in_specs=[q_full, k_tile, k_tile, q_full, row_full, row_full],
+        out_specs=[k_tile, k_tile],
+        interpret=interpret,
+    )(qb, kb, vb, gb, lse, delta)
+    return _from_bh(dk, b, tk, h, d), _from_bh(dv, b, tk, h, d)
+
+
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _forward(q, k, v, causal, block_q, block_k, interpret, with_lse=True)
     return out, (q, k, v, out, lse)
@@ -281,66 +364,10 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
 
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
     q, k, v, out, lse = residuals
-    b, t, h, d = q.shape
-    block_q = _pick_block(t, block_q)
-    block_k = _pick_block(t, block_k)
-    scale = d**-0.5
-
-    qb, kb, vb, ob, gb = (_to_bh(x, b, t, h, d) for x in (q, k, v, out, g))
-    # The softmax-grad row term: delta = rowsum(do * o) — O(T*D), no
-    # [T, T] shape, so plain XLA outside the kernels.
-    delta = jnp.sum(
-        gb.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1, keepdims=True
-    )  # [BH, T, 1]
-
-    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
-    bh = b * h
-
-    def full_spec(block):
-        return pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0), **spec_kw)
-
-    def tile_spec(block):
-        return pl.BlockSpec((1, block, d), lambda i, j: (i, j, 0), **spec_kw)
-
-    full_row = pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0), **spec_kw)
-    tile_row_q = pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0), **spec_kw)
-
-    dq = pl.pallas_call(
-        partial(_dq_kernel, causal, block_k, scale),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
-        grid=(bh, t // block_q),
-        in_specs=[
-            tile_spec(block_q),  # q
-            full_spec(t),        # k
-            full_spec(t),        # v
-            tile_spec(block_q),  # do
-            tile_row_q,          # lse
-            tile_row_q,          # delta
-        ],
-        out_specs=tile_spec(block_q),
-        interpret=interpret,
-    )(qb, kb, vb, gb, lse, delta)
-
-    dk, dv = pl.pallas_call(
-        partial(_dkv_kernel, causal, block_q, scale),
-        out_shape=[
-            jax.ShapeDtypeStruct(kb.shape, k.dtype),
-            jax.ShapeDtypeStruct(vb.shape, v.dtype),
-        ],
-        grid=(bh, t // block_k),
-        in_specs=[
-            full_spec(t),        # q
-            tile_spec(block_k),  # k
-            tile_spec(block_k),  # v
-            full_spec(t),        # do
-            full_row,            # lse
-            full_row,            # delta
-        ],
-        out_specs=[tile_spec(block_k), tile_spec(block_k)],
-        interpret=interpret,
-    )(qb, kb, vb, gb, lse, delta)
-
-    return tuple(_from_bh(x, b, t, h, d) for x in (dq, dk, dv))
+    delta = flash_delta(out, g)
+    dq = flash_dq(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
+    dk, dv = flash_dkv(q, k, v, g, lse, delta, causal, block_q, block_k, interpret)
+    return dq, dk, dv
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -28,6 +28,8 @@ full-precision normalizer — the TPU-correct numerics).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -166,6 +168,167 @@ def ring_attention(
     _, _, _, l, o = lax.fori_loop(0, axis_size, step, (k, v, m0, l0, o0))
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel doing per-hop math.
+
+    The full Ring Attention construction: K/V blocks rotate one ICI
+    neighbor per hop (as in ``ring_attention``), but each hop's blockwise
+    attention runs in the on-chip kernel (``ops/flash_attention.py``)
+    instead of XLA einsums, and per-hop results merge via logsumexp.
+    Because block offsets are multiples of T_local, every hop is one of
+    exactly three cases — fully visible (k block strictly earlier),
+    diagonal (same offset: the kernel's own causal mask applies), or
+    fully masked (skipped) — so the kernel needs no offset plumbing.
+
+    Backward is the ring FA-2: per hop, ``flash_dq`` (accumulated
+    locally) and ``flash_dkv`` computed against the FINAL merged lse;
+    dk/dv accumulators travel around the ring WITH their k/v block and
+    arrive home after the last rotation.
+    """
+    out, _ = _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret)
+    return out
+
+
+def _rfa_hop_case(k_blk, idx, causal, diag_fn, lower_fn, masked_fn):
+    """Dispatch one ring hop to its visibility case (traced selector)."""
+    if not causal:
+        return lower_fn(None)
+    return lax.cond(
+        k_blk == idx,
+        diag_fn,
+        lambda _: lax.cond(k_blk < idx, lower_fn, masked_fn, None),
+        None,
+    )
+
+
+def _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret):
+    from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
+        _from_bh,
+        _to_bh,
+        flash_forward_lse,
+    )
+
+    b, t, h, d = q.shape
+    idx = lax.axis_index(axis_name)
+    up = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    o0 = jnp.zeros((b * h, t, d), jnp.float32)
+    lse0 = jnp.full((b * h, t, 1), _MASK, jnp.float32)
+
+    def hop(s, carry):
+        kb, vb, o_acc, lse_acc = carry
+        k_blk = (idx - s) % axis_size
+
+        def compute(hop_causal):
+            def fn(_):
+                out_h, lse_h = flash_forward_lse(
+                    q, kb, vb, hop_causal, interpret=interpret
+                )
+                return _to_bh(out_h, b, t, h, d).astype(jnp.float32), lse_h
+
+            return fn
+
+        def masked(_):
+            return o0, lse0
+
+        out_h, lse_h = _rfa_hop_case(
+            k_blk, idx, causal, compute(True), compute(False), masked
+        )
+        new_lse = jnp.logaddexp(lse_acc, lse_h)
+        o_new = o_acc * jnp.exp(lse_acc - new_lse) + out_h * jnp.exp(
+            lse_h - new_lse
+        )
+        kb, vb = lax.cond(
+            s < axis_size - 1,
+            lambda kv: tuple(lax.ppermute(x, axis_name, perm=up) for x in kv),
+            lambda kv: kv,
+            (kb, vb),
+        )
+        return kb, vb, o_new, new_lse
+
+    _, _, o_acc, lse = lax.fori_loop(0, axis_size, hop, (k, v, o0, lse0))
+    return _from_bh(o_acc, b, t, h, d).astype(v.dtype), lse
+
+
+def _rfa_fwd(q, k, v, axis_name, axis_size, causal, interpret):
+    out, lse = _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _rfa_bwd(axis_name, axis_size, causal, interpret, residuals, g):
+    from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
+        flash_delta,
+        flash_dkv,
+        flash_dq,
+    )
+
+    q, k, v, out, lse = residuals
+    idx = lax.axis_index(axis_name)
+    up = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    delta = flash_delta(out, g)
+
+    dq0 = jnp.zeros_like(q, jnp.float32)
+
+    def hop(s, carry):
+        kb, vb, dk_acc, dv_acc, dq_acc = carry
+        k_blk = (idx - s) % axis_size
+
+        def dq_case(hop_causal):
+            def fn(_):
+                return flash_dq(
+                    q, kb, vb, g, lse, delta, hop_causal, interpret=interpret
+                ).astype(jnp.float32)
+
+            return fn
+
+        def dkv_case(hop_causal):
+            def fn(_):
+                dk_h, dv_h = flash_dkv(
+                    q, kb, vb, g, lse, delta, hop_causal, interpret=interpret
+                )
+                return dk_h.astype(jnp.float32), dv_h.astype(jnp.float32)
+
+            return fn
+
+        dq_h = _rfa_hop_case(
+            k_blk, idx, causal, dq_case(True), dq_case(False),
+            lambda _: dq0,
+        )
+        dk_h, dv_h = _rfa_hop_case(
+            k_blk, idx, causal, dkv_case(True), dkv_case(False),
+            lambda _: (jnp.zeros_like(kb, jnp.float32),
+                       jnp.zeros_like(vb, jnp.float32)),
+        )
+        # dk/dv accumulators travel WITH their block; after the final
+        # rotation (every hop rotates) each block's grads land home.
+        kb, vb, dk_acc, dv_acc = (
+            lax.ppermute(x, axis_name, perm=up)
+            for x in (kb, vb, dk_acc + dk_h, dv_acc + dv_h)
+        )
+        return kb, vb, dk_acc, dv_acc, dq_acc + dq_h
+
+    _, _, dk, dv, dq = lax.fori_loop(
+        0,
+        axis_size,
+        hop,
+        (k, v, jnp.zeros_like(k, jnp.float32), jnp.zeros_like(v, jnp.float32),
+         dq0),
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_flash_attention.defvjp(_rfa_fwd, _rfa_bwd)
 
 
 def ulysses_attention(
