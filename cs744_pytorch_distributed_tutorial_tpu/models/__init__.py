@@ -33,6 +33,11 @@ from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
     vgg16,
     vgg19,
 )
+from cs744_pytorch_distributed_tutorial_tpu.models.vit import (
+    ViT,
+    vit_small,
+    vit_tiny,
+)
 
 
 class TinyCNN(nn.Module):
@@ -68,6 +73,8 @@ MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {
     "resnet18": resnet18,
     "resnet34": resnet34,
     "resnet50": resnet50,
+    "vit_tiny": vit_tiny,
+    "vit_small": vit_small,
     "tiny_cnn": tiny_cnn,
 }
 # TransformerLM is deliberately NOT in MODEL_REGISTRY: the registry's
@@ -95,6 +102,9 @@ __all__ = [
     "TinyCNN",
     "TransformerLM",
     "transformer_lm",
+    "ViT",
+    "vit_small",
+    "vit_tiny",
     "VGG",
     "VGG_CFGS",
     "resnet18",

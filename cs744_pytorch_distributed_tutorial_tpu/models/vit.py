@@ -1,0 +1,98 @@
+"""Vision Transformer for the CIFAR engine's model registry.
+
+No counterpart exists in the reference (its only model is conv VGG-11,
+``master/part1/model.py:30-46``) — this family bridges the two halves of
+the zoo: it trains under the same data-parallel ``Trainer`` as VGG/ResNet
+(registry contract ``f(num_classes=, dtype=)``) while reusing the
+transformer ``Block`` (``models/transformer.py``), so attention
+improvements (the Pallas flash kernel via ``attention_impl='flash'``)
+apply to image classification unchanged.
+
+Standard ViT construction: conv patch embedding, prepended class token,
+learned position embeddings, pre-LN encoder blocks (non-causal), class
+token -> linear head. No BatchNorm — the engine's per-replica
+batch_stats tree is simply empty for this family.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from cs744_pytorch_distributed_tutorial_tpu.models.transformer import Block
+
+
+class ViT(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 4
+    d_model: int = 192
+    num_layers: int = 6
+    num_heads: int = 3
+    d_ff: int = 768
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"  # "flash" routes through the Pallas kernel
+    flash_interpret: bool | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        b, h, w, _ = x.shape
+        if h % self.patch_size or w % self.patch_size:
+            raise ValueError(
+                f"image {h}x{w} not divisible by patch_size {self.patch_size}"
+            )
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.d_model,
+            (self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        n = x.shape[1] * x.shape[2]
+        x = x.reshape(b, n, self.d_model)
+
+        cls = self.param(
+            "cls_token", nn.initializers.zeros_init(), (1, 1, self.d_model)
+        )
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.d_model)).astype(self.dtype), x],
+            axis=1,
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(stddev=0.02),
+            (1, n + 1, self.d_model),
+        )
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.num_layers):
+            x = Block(
+                num_heads=self.num_heads,
+                d_ff=self.d_ff,
+                dtype=self.dtype,
+                impl=self.attention_impl,
+                causal=False,
+                flash_interpret=self.flash_interpret,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(
+            x[:, 0]
+        )
+        return logits.astype(jnp.float32)
+
+
+def vit_tiny(**kw: Any) -> ViT:
+    """ViT-Ti/4 sized for 32x32 inputs (192 wide, 6 deep, 3 heads)."""
+    return ViT(**kw)
+
+
+def vit_small(**kw: Any) -> ViT:
+    """ViT-S/4: 384 wide, 8 deep, 6 heads."""
+    kw.setdefault("d_model", 384)
+    kw.setdefault("num_layers", 8)
+    kw.setdefault("num_heads", 6)
+    kw.setdefault("d_ff", 1536)
+    return ViT(**kw)
