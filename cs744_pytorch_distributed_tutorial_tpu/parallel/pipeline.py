@@ -169,24 +169,47 @@ def init_block_params(key, d_model: int, d_ff: int) -> dict:
     }
 
 
-def block_apply(p: dict, x: jax.Array, num_heads: int) -> jax.Array:
-    """[B, T, D] -> [B, T, D]; dense causal attention + MLP, pre-LN."""
+def block_apply(
+    p: dict,
+    x: jax.Array,
+    num_heads: int,
+    impl: str = "dense",
+    interpret: bool = False,
+) -> jax.Array:
+    """[B, T, D] -> [B, T, D]; causal attention + MLP, pre-LN.
+
+    ``impl``: "dense" (the shared ``dense_attention`` math) or "flash"
+    (the Pallas kernel, ``ops/flash_attention.py``) — the same knob the
+    other engines expose, so the pipeline rides the kernel too."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        dense_attention,
+    )
+
     b, t, d = x.shape
     h = _layer_norm(x, p["ln1_scale"], p["ln1_bias"])
     q, k, v = (
         (h @ p[w]).reshape(b, t, num_heads, d // num_heads) for w in ("wq", "wk", "wv")
     )
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d // num_heads)
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(mask, scores, -jnp.inf)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v)
+    if impl == "flash":
+        from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        attn = flash_attention(q, k, v, True, interpret=interpret)
+    else:
+        attn = dense_attention(q, k, v, causal=True)
     x = x + attn.reshape(b, t, d) @ p["wo"]
     h = _layer_norm(x, p["ln2_scale"], p["ln2_bias"])
     return x + jax.nn.gelu(h @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
 
 
 def stack_apply(
-    stacked: dict, x: jax.Array, num_heads: int, remat: bool = False
+    stacked: dict,
+    x: jax.Array,
+    num_heads: int,
+    remat: bool = False,
+    impl: str = "dense",
+    interpret: bool = False,
 ) -> jax.Array:
     """Apply a stack of blocks (leading layer dim) with one scanned body.
 
@@ -194,7 +217,7 @@ def stack_apply(
     pass recomputes each block's activations instead of the scan saving
     them — identical numerics, O(layers) less activation memory, one
     extra forward of FLOPs."""
-    fn = lambda bp, h: block_apply(bp, h, num_heads)
+    fn = lambda bp, h: block_apply(bp, h, num_heads, impl, interpret)
     if remat:
         fn = jax.checkpoint(fn)
     return lax.scan(lambda h, bp: (fn(bp, h), None), x, stacked)[0]
@@ -221,6 +244,9 @@ class PipelineLMConfig:
     # memory lever: without it every microbatch's per-layer activations
     # stay live until its backward tick.
     remat: bool = False
+    # Per-block attention: "dense" or "flash" (the Pallas kernel;
+    # interpret mode is picked from the mesh's platform).
+    attention_impl: str = "dense"
 
     global_batch_size: int = 8
     seq_len: int = 64
@@ -317,13 +343,23 @@ class PipelineLMTrainer:
         num_heads = cfg.num_heads
         tx = self.tx
         param_specs, opt_specs = self.param_specs, self.opt_specs
+        if cfg.attention_impl not in ("dense", "flash"):
+            raise ValueError(
+                f"unknown attention_impl {cfg.attention_impl!r}; the pipeline "
+                "engine supports 'dense' or 'flash'"
+            )
+        platforms = {d.platform for d in self.mesh.devices.flat}
+        interpret = platforms.isdisjoint({"tpu", "axon"})
 
         def forward(params, tokens):
             b, t = tokens.shape
             x = params["embed"][tokens] + params["pos"][:t]
             mb = x.reshape(m, b // m, t, cfg.d_model)
             out = spmd_pipeline(
-                lambda sp, h: stack_apply(sp, h, num_heads, remat=cfg.remat),
+                lambda sp, h: stack_apply(
+                    sp, h, num_heads, remat=cfg.remat,
+                    impl=cfg.attention_impl, interpret=interpret,
+                ),
                 params["blocks"],
                 mb,
                 axis_name=PIPE_AXIS,

@@ -196,6 +196,20 @@ def test_config_validation():
         make_trainer(pipe=4, layers=6)
     with pytest.raises(ValueError, match="microbatches"):
         make_trainer(data=2, pipe=2, batch=8, microbatches=3)
+    with pytest.raises(ValueError, match="attention_impl"):
+        make_trainer(attention_impl="ring")
+
+
+def test_pipeline_flash_attention_matches_dense():
+    """attention_impl='flash' routes pipeline blocks through the Pallas
+    kernel (interpret on CPU): same first-step loss as dense."""
+    losses = {}
+    for impl in ("dense", "flash"):
+        tr = make_trainer(attention_impl=impl)
+        toks = tokens_for(tr.cfg)
+        _, _, l = tr.fit(toks, steps=1)
+        losses[impl] = l[0]
+    assert losses["flash"] == pytest.approx(losses["dense"], rel=1e-5)
 
 
 def test_block_param_names_in_sync():
