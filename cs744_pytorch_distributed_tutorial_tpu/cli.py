@@ -47,7 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--momentum", type=float, default=None)
     p.add_argument("--weight-decay", type=float, default=None)
-    p.add_argument("--optimizer", choices=["sgd", "adamw"], default=None)
+    p.add_argument("--optimizer", choices=["sgd", "adamw", "lion"], default=None)
     p.add_argument("--lr-schedule",
                    choices=["constant", "cosine", "warmup_cosine"], default=None)
     p.add_argument("--warmup-steps", type=int, default=None)

@@ -62,7 +62,7 @@ class TrainConfig:
     weight_decay: float = 1e-4
     epochs: int = 1
     seed: int = 5000
-    optimizer: str = "sgd"  # "sgd" | "adamw"
+    optimizer: str = "sgd"  # "sgd" | "adamw" | "lion"
     lr_schedule: str = "constant"  # "constant" | "cosine" | "warmup_cosine"
     warmup_steps: int = 0
     total_steps: int | None = None  # required by cosine schedules

@@ -42,6 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat-policy", default="none", choices=["none", "dots"],
+                   help="remat granularity: recompute everything, or keep "
+                        "matmul outputs and recompute elementwise only")
     p.add_argument("--tie-embeddings", action="store_true",
                    help="share the token embedding with the output head")
     p.add_argument("--fused-xent", action="store_true",
@@ -122,6 +125,7 @@ def main(argv: list[str] | None = None) -> int:
         attention_impl=args.attention_impl,
         compute_dtype=args.compute_dtype,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         tie_embeddings=args.tie_embeddings,
         fused_xent=args.fused_xent,
         moe_experts=args.moe_experts,

@@ -43,6 +43,22 @@ ATTENTION_IMPLS = (
     "dense", "flash", "ring", "ring_flash", "ulysses", "ulysses_flash"
 )
 
+REMAT_POLICIES = ("none", "dots")
+
+
+def resolve_remat_policy(name: str | None):
+    """Map a policy name to a jax.checkpoint policy: "none" recomputes
+    everything in backward (maximum memory saving, one extra forward of
+    FLOPs); "dots" saves matmul outputs and recomputes only elementwise
+    ops (cheaper backward, the MXU-work-is-sacred trade)."""
+    if name in (None, "none"):
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    raise ValueError(
+        f"unknown remat_policy {name!r}; choose from {REMAT_POLICIES}"
+    )
+
 
 def default_flash_interpret() -> bool:
     """The Pallas kernel Mosaic-compiles only on TPU backends (incl. this
@@ -334,8 +350,10 @@ class TransformerLM(nn.Module):
     # Rematerialization: recompute each block's activations during the
     # backward pass instead of storing them (jax.checkpoint via nn.remat)
     # — the HBM-for-FLOPs trade that makes long sequences fit. Numerics
-    # are identical; only the autodiff schedule changes.
+    # are identical; only the autodiff schedule changes. remat_policy
+    # "dots" keeps matmul outputs (see resolve_remat_policy).
     remat: bool = False
+    remat_policy: str = "none"
     # Weight tying: reuse the token embedding as the output projection
     # (logits = x @ E^T) instead of a separate lm_head — the standard
     # vocab-parameter halving; gradients flow to the embedding from both
@@ -374,7 +392,12 @@ class TransformerLM(nn.Module):
         )(positions)
         # Remat applies to the training path only: decoding has no
         # backward pass whose activation memory it could save.
-        block_cls = nn.remat(Block) if self.remat and mode == "train" else Block
+        if self.remat and mode == "train":
+            block_cls = nn.remat(
+                Block, policy=resolve_remat_policy(self.remat_policy)
+            )
+        else:
+            block_cls = Block
         for i in range(self.num_layers):
             block = block_cls(
                 num_heads=self.num_heads,

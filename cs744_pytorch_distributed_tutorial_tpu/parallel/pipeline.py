@@ -210,16 +210,22 @@ def stack_apply(
     remat: bool = False,
     impl: str = "dense",
     interpret: bool = False,
+    remat_policy: str = "none",
 ) -> jax.Array:
     """Apply a stack of blocks (leading layer dim) with one scanned body.
 
     ``remat=True`` wraps the block in ``jax.checkpoint``: the backward
     pass recomputes each block's activations instead of the scan saving
     them — identical numerics, O(layers) less activation memory, one
-    extra forward of FLOPs."""
+    extra forward of FLOPs. ``remat_policy="dots"`` keeps matmul outputs
+    and recomputes only elementwise ops."""
     fn = lambda bp, h: block_apply(bp, h, num_heads, impl, interpret)
     if remat:
-        fn = jax.checkpoint(fn)
+        from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+            resolve_remat_policy,
+        )
+
+        fn = jax.checkpoint(fn, policy=resolve_remat_policy(remat_policy))
     return lax.scan(lambda h, bp: (fn(bp, h), None), x, stacked)[0]
 
 
@@ -244,6 +250,7 @@ class PipelineLMConfig:
     # memory lever: without it every microbatch's per-layer activations
     # stay live until its backward tick.
     remat: bool = False
+    remat_policy: str = "none"  # "dots" keeps matmul outputs
     # Per-block attention: "dense" or "flash" (the Pallas kernel;
     # interpret mode is picked from the mesh's platform).
     attention_impl: str = "dense"
@@ -359,6 +366,7 @@ class PipelineLMTrainer:
                 lambda sp, h: stack_apply(
                     sp, h, num_heads, remat=cfg.remat,
                     impl=cfg.attention_impl, interpret=interpret,
+                    remat_policy=cfg.remat_policy,
                 ),
                 params["blocks"],
                 mb,

@@ -87,8 +87,10 @@ class LMConfig:
 
     # Rematerialization: recompute block activations in backward instead
     # of storing them (jax.checkpoint) — identical numerics, O(layers)
-    # less activation HBM, one extra forward of FLOPs.
+    # less activation HBM, one extra forward of FLOPs. remat_policy
+    # "dots" keeps matmul outputs (recompute elementwise only).
     remat: bool = False
+    remat_policy: str = "none"
 
     # Weight tying: logits = x @ tok_embed^T instead of a separate
     # lm_head (halves the vocab parameters).
@@ -241,6 +243,7 @@ class LMTrainer:
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             remat=cfg.remat,
+            remat_policy=cfg.remat_policy,
             tie_embeddings=cfg.tie_embeddings,
         )
         self.tx = optax.adamw(cfg.learning_rate)

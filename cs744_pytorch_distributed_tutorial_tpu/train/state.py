@@ -89,9 +89,17 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         tx = optax.adamw(
             learning_rate=lr, b1=cfg.momentum, weight_decay=cfg.weight_decay
         )
+    elif cfg.optimizer == "lion":
+        # Sign-momentum optimizer (Chen et al. 2023): half the optimizer
+        # memory of Adam (one moment), a natural fit for memory-bound
+        # TPU training. cfg.momentum maps to b1 as for adamw.
+        tx = optax.lion(
+            learning_rate=lr, b1=cfg.momentum, weight_decay=cfg.weight_decay
+        )
     else:
         raise ValueError(
-            f"unknown optimizer {cfg.optimizer!r}; choose from ('sgd', 'adamw')"
+            f"unknown optimizer {cfg.optimizer!r}; choose from "
+            "('sgd', 'adamw', 'lion')"
         )
     if cfg.grad_clip_norm is not None:
         if cfg.grad_clip_norm <= 0:

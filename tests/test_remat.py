@@ -35,6 +35,30 @@ def test_lm_remat_matches_unremat():
     np.testing.assert_allclose(losses[False], losses[True], rtol=1e-6)
 
 
+def test_lm_remat_dots_policy_matches():
+    """remat_policy='dots' (keep matmul outputs, recompute elementwise)
+    is likewise numerically invisible."""
+    tokens = synthetic_tokens(16, SMALL["seq_len"], SMALL["vocab_size"], seed=6)
+    losses = {}
+    for policy in ("none", "dots"):
+        cfg = LMConfig(
+            **SMALL, attention_impl="ring", data_parallel=2, seq_parallel=4,
+            remat=True, remat_policy=policy,
+        )
+        tr = LMTrainer(cfg, mesh=make_mesh({"data": 2, "seq": 4}))
+        _, _, losses[policy] = tr.fit(tokens, steps=3)
+    np.testing.assert_allclose(losses["none"], losses["dots"], rtol=1e-6)
+
+    import pytest
+
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+        resolve_remat_policy,
+    )
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        resolve_remat_policy("everything")
+
+
 def test_pipeline_remat_matches_unremat():
     tokens = synthetic_tokens(32, 16, 64, seed=5)
     losses = {}
