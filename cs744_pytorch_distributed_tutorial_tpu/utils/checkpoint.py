@@ -3,8 +3,13 @@ reference, which has none: no ``torch.save``/``load`` anywhere, training
 is one epoch from scratch (``master/part1/part1.py:101``; SURVEY §5.4).
 
 Saves the full ``TrainState`` pytree (params, per-replica BN stats,
-optimizer state, step) with its shardings; restore round-trips through
-the same mesh layout.
+optimizer state, step) with its shardings. Restore is **mesh-elastic**:
+a checkpoint written on an N-device mesh loads into an M-device
+trainer — world-size-shaped leaves (the per-replica ``[num_devices,
+...]`` BN-stats axis) are sliced (shrinking) or cyclically tiled
+(growing) to the new world, everything else redistributes via the
+template's shardings. The reference's fixed ``[0,1,2,3]`` world
+(``master/part2a/part2a.py:32``) rules this out by construction.
 """
 
 from __future__ import annotations
@@ -13,6 +18,19 @@ import os
 from typing import Any
 
 import jax
+import numpy as np
+
+
+def _path_key(path) -> str:
+    parts = []
+    for p in path:
+        for attr in ("name", "key", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
 
 
 class Checkpointer:
@@ -37,13 +55,56 @@ class Checkpointer:
 
     def restore_latest(self, template: Any) -> Any | None:
         """Restore the newest checkpoint into ``template``'s structure and
-        shardings; None if the directory has no checkpoints."""
+        shardings; None if the directory has no checkpoints. Leaves whose
+        SAVED leading axis differs from the template's (a different world
+        size) are resized — slice down, or tile cyclically up."""
         step = self.manager.latest_step()
         if step is None:
             return None
-        return self.manager.restore(
-            step, args=self._ocp.args.StandardRestore(template)
+        try:
+            return self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(template)
+            )
+        except ValueError:
+            pass  # shape mismatch: mesh-elastic path below
+
+        # Build a restore target with the SAVED shapes (matched by path
+        # name — metadata is dict-structured, the template may be a
+        # dataclass), restore at those shapes, then adapt leading axes.
+        meta = self.manager.item_metadata(step)
+        meta_by_path = {
+            _path_key(p): m
+            for p, m in jax.tree_util.tree_flatten_with_path(meta)[0]
+        }
+
+        def saved_shaped(path, leaf):
+            m = meta_by_path.get(_path_key(path))
+            if m is None or tuple(m.shape) == tuple(leaf.shape):
+                return leaf
+            return jax.ShapeDtypeStruct(tuple(m.shape), leaf.dtype)
+
+        target = jax.tree_util.tree_map_with_path(saved_shaped, template)
+        raw = self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(target)
         )
+
+        def adapt(saved, like):
+            saved = np.asarray(jax.device_get(saved))
+            if saved.shape == like.shape:
+                return saved
+            if saved.shape[1:] != like.shape[1:] or saved.ndim == 0:
+                raise ValueError(
+                    f"cannot adapt checkpoint leaf of shape {saved.shape} to "
+                    f"{like.shape}: only the leading (world-size) axis may "
+                    "differ"
+                )
+            n = like.shape[0]
+            if saved.shape[0] >= n:
+                return saved[:n]
+            reps = -(-n // saved.shape[0])
+            return np.tile(saved, (reps,) + (1,) * (saved.ndim - 1))[:n]
+
+        return jax.tree.map(adapt, raw, template)
 
     def close(self) -> None:
         self.manager.close()
