@@ -57,6 +57,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clip the global gradient norm before the optimizer")
     p.add_argument("--label-smoothing", type=float, default=None,
                    help="smoothed CE target: (1-s) one-hot + s/num_classes")
+    p.add_argument("--accum-steps", type=int, default=None,
+                   help="sequential microbatches per device batch shard")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--data-root", default=None)
     p.add_argument("--synthetic-data", action="store_true", default=None,
@@ -125,6 +127,7 @@ _ARG_TO_FIELD = {
     "total_steps": "total_steps",
     "grad_clip_norm": "grad_clip_norm",
     "label_smoothing": "label_smoothing",
+    "accum_steps": "accum_steps",
     "seed": "seed",
     "data_root": "data_root",
     "synthetic_data": "synthetic_data",

@@ -73,6 +73,10 @@ class TrainConfig:
     # Label smoothing: target distribution (1-s) one-hot + s/num_classes.
     # 0.0 reproduces the reference's plain CE (master/part1/part1.py:94).
     label_smoothing: float = 0.0
+    # Gradient accumulation: split each device's batch shard into this
+    # many sequential microbatches (lax.scan) — one microbatch's
+    # activations live at a time. BN statistics update per microbatch.
+    accum_steps: int = 1
 
     # Parallelism
     sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp

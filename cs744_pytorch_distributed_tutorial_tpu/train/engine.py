@@ -108,6 +108,12 @@ class Trainer:
             raise ValueError(
                 f"label_smoothing must be in [0, 1), got {cfg.label_smoothing}"
             )
+        per_device = cfg.global_batch_size // self.axis_size
+        if cfg.accum_steps < 1 or per_device % cfg.accum_steps:
+            raise ValueError(
+                f"accum_steps {cfg.accum_steps} must divide the per-device "
+                f"batch shard ({per_device})"
+            )
         model_kw = {}
         if cfg.model.startswith("resnet"):
             use_imagenet_stem = (
@@ -244,19 +250,15 @@ class Trainer:
                 lambda: model.init(jax.random.key(0), sample, train=False)
             )["params"]
 
-        def local_train_step(state: TrainState, images, labels, base_key):
-            # Per-device, per-step augmentation randomness: fold the run key
-            # with the step and the replica index (the DistributedSampler
-            # seed-discipline analog, master/part2a/part2a.py:89-90).
-            key = jax.random.fold_in(base_key, state.step)
-            key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
-            x = augment_train_batch(key, images)
+        accum = cfg.accum_steps
 
-            local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
+        def microbatch_grads(params, local_stats, x, labels):
+            """One fwd/bwd on an (augmented) local microbatch under the
+            configured sync strategy: (loss, local_loss, grads, stats)."""
 
-            def local_loss_fn(params):
+            def local_loss_fn(p):
                 logits, mutated = model.apply(
-                    {"params": params, "batch_stats": local_stats},
+                    {"params": p, "batch_stats": local_stats},
                     x,
                     train=True,
                     mutable=["batch_stats"],
@@ -271,26 +273,79 @@ class Trainer:
                 (local_loss, new_stats), grads = jax.value_and_grad(
                     lambda sh: local_loss_fn(tx.gather_params(sh, param_shapes)),
                     has_aux=True,
-                )(state.params)
+                )(params)
                 loss = lax.pmean(local_loss, DATA_AXIS)
             elif framework_inserted_sync:
 
-                def global_loss_fn(params):
-                    local, new_stats = local_loss_fn(params)
+                def global_loss_fn(p):
+                    local, new_stats = local_loss_fn(p)
                     return lax.pmean(local, DATA_AXIS), (local, new_stats)
 
                 (loss, (local_loss, new_stats)), grads = jax.value_and_grad(
                     global_loss_fn, has_aux=True
-                )(state.params)
+                )(params)
             else:
                 params_local = jax.tree.map(
-                    lambda p: lax.pcast(p, DATA_AXIS, to="varying"), state.params
+                    lambda p: lax.pcast(p, DATA_AXIS, to="varying"), params
                 )
                 (local_loss, new_stats), grads = jax.value_and_grad(
                     local_loss_fn, has_aux=True
                 )(params_local)
                 grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
                 loss = lax.pmean(local_loss, DATA_AXIS)
+            return loss, local_loss, grads, new_stats
+
+        def local_train_step(state: TrainState, images, labels, base_key):
+            # Per-device, per-step augmentation randomness: fold the run key
+            # with the step and the replica index (the DistributedSampler
+            # seed-discipline analog, master/part2a/part2a.py:89-90).
+            key = jax.random.fold_in(base_key, state.step)
+            key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+            x = augment_train_batch(key, images)
+
+            local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
+
+            if accum == 1:
+                loss, local_loss, grads, new_stats = microbatch_grads(
+                    state.params, local_stats, x, labels
+                )
+            else:
+                # Gradient accumulation: scan over microbatches — only ONE
+                # microbatch's activations are live at a time; grad sums
+                # average into the identical-global-batch gradient (up to
+                # summation order). BatchNorm statistics update per
+                # MICROBATCH (sequentially, torch-accumulation semantics),
+                # so BN models' trajectories legitimately differ from the
+                # unaccumulated step; BN-free models match exactly.
+                xm = x.reshape(accum, -1, *x.shape[1:])
+                ym = labels.reshape(accum, -1)
+
+                def body(carry, mb):
+                    g_sum, l_sum, ll_sum, stats = carry
+                    loss, ll, g, stats = microbatch_grads(
+                        state.params, stats, mb[0], mb[1]
+                    )
+                    return (
+                        jax.tree.map(jnp.add, g_sum, g),
+                        l_sum + loss.astype(jnp.float32),
+                        ll_sum + ll.astype(jnp.float32),
+                        stats,
+                    ), None
+
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, p.dtype), state.params
+                )
+                zero = jnp.zeros((), jnp.float32)
+                # local_loss is device-varying; its accumulator's initial
+                # value must carry the same varying-axes type under
+                # shard_map's replication analysis.
+                zero_var = lax.pcast(zero, DATA_AXIS, to="varying")
+                (g_sum, l_sum, ll_sum, new_stats), _ = lax.scan(
+                    body, (zeros, zero, zero_var, local_stats), (xm, ym)
+                )
+                grads = jax.tree.map(lambda g: g / accum, g_sum)
+                loss = l_sum / accum
+                local_loss = ll_sum / accum
 
             if self._zero1 or self._fsdp or cfg.fused_optimizer:
                 # Under zero1 the grads are still LOCAL here: Zero1SGD
