@@ -104,6 +104,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--distributed", action="store_true",
                    help="multi-host autodetect rendezvous (Cloud TPU pods): "
                         "run jax.distributed.initialize() with no args")
+    p.add_argument("--eval-only", action="store_true",
+                   help="restore --checkpoint-dir's newest checkpoint and "
+                        "evaluate; no training")
     p.add_argument("--json", action="store_true",
                    help="print a final JSON summary line")
     return p
@@ -180,6 +183,17 @@ def main(argv: list[str] | None = None) -> int:
     from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
     trainer = Trainer(cfg)
+    if args.eval_only:
+        metrics = trainer.evaluate_only()
+        if args.json:
+            print(json.dumps({
+                "sync": cfg.sync,
+                "model": cfg.model,
+                "num_devices": trainer.axis_size,
+                "final_eval_loss": metrics["avg_loss"],
+                "final_eval_accuracy": metrics["accuracy"],
+            }))
+        return 0
     if args.max_restarts > 0:
         from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
             run_with_recovery,

@@ -776,6 +776,55 @@ class Trainer:
                 ckpt.close()
         return state, history
 
+    def evaluate_only(self, dataset=None) -> dict[str, float]:
+        """Restore the newest checkpoint (``cfg.checkpoint_dir``) and run
+        the held-out evaluation without training — the deploy-time/
+        validation entry point (CLI: ``--eval-only``). Without a
+        checkpoint dir this evaluates freshly initialized params."""
+        cfg = self.cfg
+        if dataset is None:
+            dataset = load_cifar10(
+                cfg.data_root,
+                synthetic=cfg.synthetic_data,
+                synthetic_train_size=cfg.synthetic_train_size,
+                synthetic_test_size=cfg.synthetic_test_size,
+                image_size=cfg.image_size,
+                num_classes=cfg.num_classes,
+            )
+        test_loader = BatchLoader(
+            dataset.test_images,
+            dataset.test_labels,
+            cfg.global_batch_size,
+            mesh=self.mesh,
+            shuffle=False,
+            drop_last=False,
+        )
+        state = self.init()
+        if cfg.checkpoint_dir:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
+                Checkpointer,
+            )
+
+            ckpt = Checkpointer(cfg.checkpoint_dir)
+            try:
+                restored = ckpt.restore_latest(state)
+            finally:
+                ckpt.close()
+            if restored is None:
+                raise FileNotFoundError(
+                    f"no checkpoint under {cfg.checkpoint_dir!r} to evaluate"
+                )
+            state = self.place_state(restored)
+        metrics = self.evaluate(state, test_loader)
+        self.log.info(
+            "Test set: Average loss: %.4f, Accuracy: %d/%d (%.0f%%)",
+            metrics["avg_loss"],
+            metrics["correct"],
+            metrics["count"],
+            100.0 * metrics["accuracy"],
+        )
+        return metrics
+
     def evaluate(
         self, state: TrainState, test_loader: BatchLoader, watchdog=None
     ) -> dict[str, float]:

@@ -53,6 +53,32 @@ def test_fit_saves_and_resumes(tmp_path):
     assert int(jax.device_get(state3.step)) == final_step * 2
 
 
+def test_evaluate_only_restores_and_matches(tmp_path):
+    """evaluate_only reproduces the training run's final eval from the
+    checkpoint alone (the --eval-only CLI path)."""
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    ds = synthetic_cifar10(64, 16, seed=4)
+    cfg = TrainConfig(model="tiny_cnn", sync="allreduce", num_devices=2,
+                      global_batch_size=16, epochs=1, synthetic_data=True,
+                      checkpoint_dir=str(tmp_path / "run"))
+    tr = Trainer(cfg, mesh=mesh)
+    _, history = tr.fit(dataset=ds)
+
+    tr2 = Trainer(cfg, mesh=mesh)
+    metrics = tr2.evaluate_only(dataset=ds)
+    assert metrics["accuracy"] == pytest.approx(
+        history["eval"][-1]["accuracy"]
+    )
+    assert metrics["avg_loss"] == pytest.approx(
+        history["eval"][-1]["avg_loss"], rel=1e-6
+    )
+
+    with pytest.raises(FileNotFoundError, match="no checkpoint"):
+        Trainer(
+            cfg.replace(checkpoint_dir=str(tmp_path / "empty")), mesh=mesh
+        ).evaluate_only(dataset=ds)
+
+
 def test_mesh_elastic_resume(tmp_path):
     """A checkpoint written on a 4-device mesh resumes on a 2-device mesh
     (and vice versa): Orbax restores into the NEW template's shardings,
