@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "matmul outputs and recompute elementwise only")
     p.add_argument("--tie-embeddings", action="store_true",
                    help="share the token embedding with the output head")
+    p.add_argument("--use-rope", action="store_true",
+                   help="rotary position embeddings instead of the learned "
+                        "absolute table")
     p.add_argument("--fused-xent", action="store_true",
                    help="Pallas fused softmax cross-entropy (ops/fused_xent.py)")
     # MoE
@@ -127,6 +130,7 @@ def main(argv: list[str] | None = None) -> int:
         remat=args.remat,
         remat_policy=args.remat_policy,
         tie_embeddings=args.tie_embeddings,
+        use_rope=args.use_rope,
         fused_xent=args.fused_xent,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,

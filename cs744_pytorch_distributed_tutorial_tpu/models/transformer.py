@@ -46,6 +46,32 @@ ATTENTION_IMPLS = (
 REMAT_POLICIES = ("none", "dots")
 
 
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0
+) -> jnp.ndarray:
+    """Rotary position embedding on [B, T, H, D] (D even).
+
+    Pairs dimension i with i + D/2 and rotates each pair by
+    ``positions * base**(-2i/D)`` — attention then depends on RELATIVE
+    positions only, which is what makes RoPE exact under sequence
+    sharding: each shard rotates its q/k by its GLOBAL positions before
+    any collective, and ring/all-to-all attention needs no further
+    position bookkeeping.
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {d}")
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    sin = jnp.sin(angles)[None, :, None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).astype(x.dtype)
+
+
 def resolve_remat_policy(name: str | None):
     """Map a policy name to a jax.checkpoint policy: "none" recomputes
     everything in backward (maximum memory saving, one extra forward of
@@ -96,6 +122,10 @@ class Attention(nn.Module):
     # KV-cache length for autoregressive decoding (infer/generate.py);
     # required when __call__ runs in "prefill"/"decode" mode.
     max_decode_len: int | None = None
+    # Rotary position embeddings applied to q/k (global positions, so
+    # sequence sharding and cached decode are position-exact).
+    rope: bool = False
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -138,6 +168,21 @@ class Attention(nn.Module):
         v = proj(name="v")(x)
         shape = (b, t, heads_local, head_dim)
         q, k, v = (a.reshape(shape) for a in (q, k, v))
+
+        if self.rope:
+            # GLOBAL positions of this block's tokens: the shard offset
+            # under sequence sharding, the cache position when decoding.
+            if mode == "decode":
+                if decode_pos is None:
+                    raise ValueError("mode='decode' needs decode_pos")
+                offset = decode_pos
+            elif self.seq_axis is not None and self.seq_axis_size > 1:
+                offset = lax.axis_index(self.seq_axis) * t
+            else:
+                offset = 0
+            positions = offset + jnp.arange(t)
+            q = apply_rope(q, positions, self.rope_base)
+            k = apply_rope(k, positions, self.rope_base)
 
         decode_step = False
         if mode != "train":
@@ -248,6 +293,8 @@ class Block(nn.Module):
     expert_axis: str | None = None
     expert_axis_size: int = 1
     max_decode_len: int | None = None
+    rope: bool = False
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -280,6 +327,8 @@ class Block(nn.Module):
             causal=self.causal,
             flash_interpret=self.flash_interpret,
             max_decode_len=self.max_decode_len,
+            rope=self.rope,
+            rope_base=self.rope_base,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -359,6 +408,11 @@ class TransformerLM(nn.Module):
     # vocab-parameter halving; gradients flow to the embedding from both
     # uses.
     tie_embeddings: bool = False
+    # Rotary position embeddings (use_rope=True): q/k rotate by their
+    # GLOBAL positions inside attention and the learned absolute
+    # pos_embed table is dropped — the modern long-context default.
+    use_rope: bool = False
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(
@@ -386,10 +440,12 @@ class TransformerLM(nn.Module):
                 if self.seq_axis is not None and self.seq_axis_size > 1
                 else 0
             )
-        positions = offset + jnp.arange(t_local)
-        x = x + nn.Embed(
-            self.max_seq_len, self.d_model, dtype=self.dtype, name="pos_embed"
-        )(positions)
+        if not self.use_rope:
+            positions = offset + jnp.arange(t_local)
+            x = x + nn.Embed(
+                self.max_seq_len, self.d_model, dtype=self.dtype,
+                name="pos_embed",
+            )(positions)
         # Remat applies to the training path only: decoding has no
         # backward pass whose activation memory it could save.
         if self.remat and mode == "train":
@@ -416,6 +472,8 @@ class TransformerLM(nn.Module):
                 expert_axis=self.expert_axis,
                 expert_axis_size=self.expert_axis_size,
                 max_decode_len=self.max_seq_len,
+                rope=self.use_rope,
+                rope_base=self.rope_base,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults

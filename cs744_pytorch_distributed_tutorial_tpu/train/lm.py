@@ -96,6 +96,11 @@ class LMConfig:
     # lm_head (halves the vocab parameters).
     tie_embeddings: bool = False
 
+    # Rotary position embeddings: relative positions inside attention
+    # instead of the learned absolute table (exact under sequence
+    # sharding and cached decode).
+    use_rope: bool = False
+
     # Pallas fused softmax-CE (ops/fused_xent.py): one pass over the
     # logits instead of materializing the [N, V] log-softmax — the
     # large-vocab loss lever. Interpret mode off-TPU.
@@ -245,6 +250,7 @@ class LMTrainer:
             remat=cfg.remat,
             remat_policy=cfg.remat_policy,
             tie_embeddings=cfg.tie_embeddings,
+            use_rope=cfg.use_rope,
         )
         self.tx = optax.adamw(cfg.learning_rate)
         if cfg.grad_clip_norm is not None:
