@@ -65,6 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--optimizer", default="adamw",
+                   choices=["adamw", "sgd", "lion"])
+    p.add_argument("--lr-schedule", default="constant",
+                   choices=["constant", "cosine", "warmup_cosine"])
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--grad-clip-norm", type=float, default=None)
     p.add_argument("--label-smoothing", type=float, default=0.0)
     p.add_argument("--accum-steps", type=int, default=1)
@@ -141,6 +147,12 @@ def main(argv: list[str] | None = None) -> int:
         global_batch_size=args.global_batch_size,
         seq_len=args.seq_len,
         learning_rate=args.lr,
+        optimizer=args.optimizer,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        # Cosine schedules decay over the full requested run by default.
+        total_steps=args.steps if args.lr_schedule != "constant" else None,
+        weight_decay=args.weight_decay,
         grad_clip_norm=args.grad_clip_norm,
         label_smoothing=args.label_smoothing,
         accum_steps=args.accum_steps,

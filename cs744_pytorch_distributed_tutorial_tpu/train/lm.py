@@ -81,6 +81,15 @@ class LMConfig:
     seq_len: int = 256  # tokens per sequence fed to the model
     learning_rate: float = 1e-3
     seed: int = 0
+    # Optimizer/schedule registry (same options as the CIFAR engine's
+    # TrainConfig; resolved through train/state.py): cosine schedules
+    # need total_steps, warmup ramps linearly from 0 first.
+    optimizer: str = "adamw"  # "adamw" | "sgd" | "lion"
+    lr_schedule: str = "constant"  # "constant" | "cosine" | "warmup_cosine"
+    warmup_steps: int = 0
+    total_steps: int | None = None
+    momentum: float = 0.9  # adamw/lion b1; sgd momentum
+    weight_decay: float = 1e-4  # optax.adamw's default, kept for the golden trace
     # Clip the global gradient norm before AdamW sees it; None disables.
     # The standard long-context stabilizer (loss spikes on long sequences).
     grad_clip_norm: float | None = None
@@ -252,25 +261,25 @@ class LMTrainer:
             tie_embeddings=cfg.tie_embeddings,
             use_rope=cfg.use_rope,
         )
-        self.tx = optax.adamw(cfg.learning_rate)
-        if cfg.grad_clip_norm is not None:
-            if cfg.grad_clip_norm <= 0:
-                raise ValueError(
-                    f"grad_clip_norm must be > 0, got {cfg.grad_clip_norm}"
-                )
-            if self.tensor_size > 1 or self.expert_parallel:
-                # The clip transform computes the norm over each device's
-                # LOCAL grads inside shard_map; with tensor- or expert-
-                # sharded params that norm is incomplete AND device-varying
-                # (a replication-divergence bug, not just a wrong bound).
-                raise ValueError(
-                    "grad_clip_norm requires fully replicated gradients; "
-                    f"got tensor_parallel={self.tensor_size}, "
-                    f"expert_parallel={self.expert_parallel}"
-                )
-            self.tx = optax.chain(
-                optax.clip_by_global_norm(cfg.grad_clip_norm), self.tx
+        if cfg.grad_clip_norm is not None and (
+            self.tensor_size > 1 or self.expert_parallel
+        ):
+            # The clip transform computes the norm over each device's
+            # LOCAL grads inside shard_map; with tensor- or expert-
+            # sharded params that norm is incomplete AND device-varying
+            # (a replication-divergence bug, not just a wrong bound).
+            raise ValueError(
+                "grad_clip_norm requires fully replicated gradients; "
+                f"got tensor_parallel={self.tensor_size}, "
+                f"expert_parallel={self.expert_parallel}"
             )
+        # The shared optimizer/schedule registry (train/state.py) reads
+        # the same field names LMConfig defines — duck-typed on purpose.
+        from cs744_pytorch_distributed_tutorial_tpu.train.state import (
+            make_optimizer,
+        )
+
+        self.tx = make_optimizer(cfg)
         # Partition specs: how each GLOBAL param (and its optimizer state)
         # splits over the tensor axis. Built once from the init shapes.
         param_shapes = jax.eval_shape(

@@ -153,6 +153,30 @@ def test_evaluate_returns_perplexity():
         tr.evaluate(params, tokens[:2])
 
 
+def test_lm_optimizer_registry():
+    """LMConfig rides the shared optimizer/schedule registry: warmup-
+    cosine AdamW and SGD both train; trajectories differ."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+
+    mesh = make_mesh({"data": 2, "seq": 2})
+    tokens = synthetic_tokens(8, SMALL["seq_len"], SMALL["vocab_size"], seed=12)
+    params = {}
+    for name, extra in [
+        ("adamw", dict(lr_schedule="warmup_cosine", warmup_steps=2,
+                       total_steps=8)),
+        ("sgd", {}),
+    ]:
+        cfg = LMConfig(**SMALL, attention_impl="ring", data_parallel=2,
+                       seq_parallel=2, optimizer=name, **extra)
+        tr = LMTrainer(cfg, mesh=mesh)
+        p, _, losses = tr.fit(tokens, steps=3)
+        assert np.isfinite(losses).all(), (name, losses)
+        params[name] = p
+    a = jax.tree.leaves(jax.device_get(params["adamw"]))
+    b = jax.tree.leaves(jax.device_get(params["sgd"]))
+    assert any(not np.allclose(x, y) for x, y in zip(a, b))
+
+
 def test_grad_clip_changes_trajectory_and_stays_replicated():
     """Clipped AdamW runs the distributed step; a binding bound changes
     the trajectory; params remain replicated (the clip factor must be
