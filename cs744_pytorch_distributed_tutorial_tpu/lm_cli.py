@@ -30,6 +30,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ignored with --text-file (byte vocab = 256)")
     p.add_argument("--num-layers", type=int, default=4)
     p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--num-kv-heads", type=int, default=None,
+                   help="grouped-query attention KV head count (1 = MQA)")
     p.add_argument("--d-model", type=int, default=256)
     p.add_argument("--d-ff", type=int, default=1024)
     p.add_argument("--max-seq-len", type=int, default=2048)
@@ -128,6 +130,7 @@ def main(argv: list[str] | None = None) -> int:
         vocab_size=vocab,
         num_layers=args.num_layers,
         num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads,
         d_model=args.d_model,
         d_ff=args.d_ff,
         max_seq_len=args.max_seq_len,

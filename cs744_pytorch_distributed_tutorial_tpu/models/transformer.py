@@ -126,6 +126,11 @@ class Attention(nn.Module):
     # sequence sharding and cached decode are position-exact).
     rope: bool = False
     rope_base: float = 10000.0
+    # Grouped-query attention: K/V get this many heads (must divide
+    # num_heads; 1 = multi-query). The KV cache stores only KV heads —
+    # the decode-memory/bandwidth lever — and K/V repeat up to the query
+    # head count at compute time. None = standard MHA.
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(
@@ -158,16 +163,26 @@ class Attention(nn.Module):
         heads_local = (
             self.num_heads // self.tensor_axis_size if tp else self.num_heads
         )
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(
+                f"num_kv_heads {kv_heads} must divide num_heads {self.num_heads}"
+            )
+        if tp and kv_heads % self.tensor_axis_size:
+            raise ValueError(
+                f"num_kv_heads {kv_heads} not divisible by tensor axis "
+                f"{self.tensor_axis_size}"
+            )
+        kv_local = kv_heads // self.tensor_axis_size if tp else kv_heads
         if tp:
             x = copy_to_tp_region(x, self.tensor_axis)
-        proj = partial(
-            nn.Dense, heads_local * head_dim, use_bias=False, dtype=self.dtype
-        )
-        q = proj(name="q")(x)
-        k = proj(name="k")(x)
-        v = proj(name="v")(x)
-        shape = (b, t, heads_local, head_dim)
-        q, k, v = (a.reshape(shape) for a in (q, k, v))
+        proj = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        q = proj(heads_local * head_dim, name="q")(x)
+        k = proj(kv_local * head_dim, name="k")(x)
+        v = proj(kv_local * head_dim, name="v")(x)
+        q = q.reshape(b, t, heads_local, head_dim)
+        k = k.reshape(b, t, kv_local, head_dim)
+        v = v.reshape(b, t, kv_local, head_dim)
 
         if self.rope:
             # GLOBAL positions of this block's tokens: the shard offset
@@ -200,7 +215,10 @@ class Attention(nn.Module):
                 raise ValueError(
                     f"mode={mode!r} needs max_decode_len (the KV-cache length)"
                 )
-            cache_shape = (b, self.max_decode_len, heads_local, head_dim)
+            # Only KV heads are cached — with GQA this is the
+            # num_heads/num_kv_heads memory and bandwidth saving per
+            # decode step.
+            cache_shape = (b, self.max_decode_len, kv_local, head_dim)
             ck = self.variable("cache", "cached_key", jnp.zeros, cache_shape, k.dtype)
             cv = self.variable(
                 "cache", "cached_value", jnp.zeros, cache_shape, v.dtype
@@ -231,8 +249,20 @@ class Attention(nn.Module):
             if self.flash_interpret is not None
             else default_flash_interpret()
         )
+        # GQA: repeat K/V heads up to the query head count for compute
+        # (cache and ring/all-to-all payloads stay at kv heads where
+        # possible; repeat happens at the last responsible moment).
+        rep = heads_local // kv_local
         if decode_step:
-            out = decode_attention(q, ck.value, cv.value, decode_pos)
+            ka, va = ck.value, cv.value
+        else:
+            ka, va = k, v
+        if rep > 1:
+            ka = jnp.repeat(ka, rep, axis=2)
+            va = jnp.repeat(va, rep, axis=2)
+        k, v = ka, va
+        if decode_step:
+            out = decode_attention(q, k, v, decode_pos)
         elif self.seq_axis is None or self.seq_axis_size == 1:
             if self.impl in ("flash", "ring_flash", "ulysses_flash"):
                 from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
@@ -295,6 +325,7 @@ class Block(nn.Module):
     max_decode_len: int | None = None
     rope: bool = False
     rope_base: float = 10000.0
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(
@@ -329,6 +360,7 @@ class Block(nn.Module):
             max_decode_len=self.max_decode_len,
             rope=self.rope,
             rope_base=self.rope_base,
+            num_kv_heads=self.num_kv_heads,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
@@ -413,6 +445,9 @@ class TransformerLM(nn.Module):
     # pos_embed table is dropped — the modern long-context default.
     use_rope: bool = False
     rope_base: float = 10000.0
+    # Grouped-query attention: KV head count (None = num_heads). The KV
+    # cache shrinks by num_heads/num_kv_heads.
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(
@@ -474,6 +509,7 @@ class TransformerLM(nn.Module):
                 max_decode_len=self.max_seq_len,
                 rope=self.use_rope,
                 rope_base=self.rope_base,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults

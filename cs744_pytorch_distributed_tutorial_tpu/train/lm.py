@@ -110,6 +110,10 @@ class LMConfig:
     # sharding and cached decode).
     use_rope: bool = False
 
+    # Grouped-query attention: KV head count (None = num_heads; 1 = MQA).
+    # Shrinks the decode KV cache by num_heads/num_kv_heads.
+    num_kv_heads: int | None = None
+
     # Pallas fused softmax-CE (ops/fused_xent.py): one pass over the
     # logits instead of materializing the [N, V] log-softmax — the
     # large-vocab loss lever. Interpret mode off-TPU.
@@ -260,6 +264,7 @@ class LMTrainer:
             remat_policy=cfg.remat_policy,
             tie_embeddings=cfg.tie_embeddings,
             use_rope=cfg.use_rope,
+            num_kv_heads=cfg.num_kv_heads,
         )
         if cfg.grad_clip_norm is not None and (
             self.tensor_size > 1 or self.expert_parallel
