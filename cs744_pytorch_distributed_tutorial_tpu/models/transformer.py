@@ -163,10 +163,13 @@ class Attention(nn.Module):
         heads_local = (
             self.num_heads // self.tensor_axis_size if tp else self.num_heads
         )
-        kv_heads = self.num_kv_heads or self.num_heads
-        if self.num_heads % kv_heads:
+        kv_heads = (
+            self.num_heads if self.num_kv_heads is None else self.num_kv_heads
+        )
+        if kv_heads < 1 or self.num_heads % kv_heads:
             raise ValueError(
-                f"num_kv_heads {kv_heads} must divide num_heads {self.num_heads}"
+                f"num_kv_heads {kv_heads} must be >= 1 and divide "
+                f"num_heads {self.num_heads}"
             )
         if tp and kv_heads % self.tensor_axis_size:
             raise ValueError(
@@ -249,20 +252,18 @@ class Attention(nn.Module):
             if self.flash_interpret is not None
             else default_flash_interpret()
         )
-        # GQA: repeat K/V heads up to the query head count for compute
-        # (cache and ring/all-to-all payloads stay at kv heads where
-        # possible; repeat happens at the last responsible moment).
+        # GQA: the CACHE stays at kv heads (the decode memory/bandwidth
+        # saving — decode_attention groups query heads over it without
+        # materializing a repeat). The train/prefill compute paths repeat
+        # K/V up to the query head count first, so ring/all-to-all
+        # collectives DO ship full-width tensors; grouped ring/ulysses
+        # variants would be the further optimization.
         rep = heads_local // kv_local
+        if not decode_step and rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         if decode_step:
-            ka, va = ck.value, cv.value
-        else:
-            ka, va = k, v
-        if rep > 1:
-            ka = jnp.repeat(ka, rep, axis=2)
-            va = jnp.repeat(va, rep, axis=2)
-        k, v = ka, va
-        if decode_step:
-            out = decode_attention(q, k, v, decode_pos)
+            out = decode_attention(q, ck.value, cv.value, decode_pos)
         elif self.seq_axis is None or self.seq_axis_size == 1:
             if self.impl in ("flash", "ring_flash", "ulysses_flash"):
                 from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (

@@ -76,24 +76,34 @@ def decode_attention(
 ) -> jax.Array:
     """One autoregressive decode step against a KV cache.
 
-    ``q`` is [B, 1, H, D] (the new token's query); ``cached_k``/``cached_v``
-    are [B, L, H, D] caches whose entries at positions > ``pos`` (the new
-    token's global position) are unwritten garbage — masked out here, so
-    softmax weights for them are exactly 0.0 and the result matches
-    ``dense_attention`` over the first ``pos+1`` positions. Same numerics
-    discipline as the other variants: float32 scores/softmax, PV matmul in
-    the cache dtype.
+    ``q`` is [B, 1, Hq, D] (the new token's query); ``cached_k``/
+    ``cached_v`` are [B, L, Hkv, D] caches whose entries at positions >
+    ``pos`` (the new token's global position) are unwritten garbage —
+    masked out here, so softmax weights for them are exactly 0.0 and the
+    result matches ``dense_attention`` over the first ``pos+1`` positions.
+    ``Hq`` may be a multiple of ``Hkv`` (grouped-query attention): query
+    heads group over the shared KV heads directly in the einsums — the
+    cache is never materialized at query-head width, which is GQA's
+    decode-bandwidth saving. Same numerics discipline as the other
+    variants: float32 scores/softmax, PV matmul in the cache dtype.
     """
-    scale = q.shape[-1] ** -0.5
+    b, one, hq, d = q.shape
+    hkv = cached_k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    qg = q.reshape(b, one, hkv, group, d)
+    scale = d**-0.5
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, cached_k, preferred_element_type=jnp.float32
+        "bqhgd,bkhd->bhgqk", qg, cached_k, preferred_element_type=jnp.float32
     ) * scale
     k_pos = jnp.arange(cached_k.shape[1])
-    scores = jnp.where(k_pos[None, None, None, :] <= pos, scores, _MASK)
+    scores = jnp.where(k_pos[None, None, None, None, :] <= pos, scores, _MASK)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum(
-        "bhqk,bkhd->bqhd", probs.astype(cached_v.dtype), cached_v,
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(cached_v.dtype), cached_v,
     )
+    return out.reshape(b, one, hq, d)
 
 
 def ring_attention(

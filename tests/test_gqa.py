@@ -57,9 +57,10 @@ def test_gqa_decode_matches_full_forward(kv):
 
 
 def test_gqa_rejects_indivisible_heads():
-    model = TransformerLM(**KW, num_kv_heads=3)
-    with pytest.raises(ValueError, match="divide"):
-        model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
+    for bad in (3, 0, -2):
+        model = TransformerLM(**KW, num_kv_heads=bad)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
 
 
 def test_gqa_trains_seq_parallel_and_generates():
