@@ -252,14 +252,16 @@ class Attention(nn.Module):
             if self.flash_interpret is not None
             else default_flash_interpret()
         )
-        # GQA: the CACHE stays at kv heads (the decode memory/bandwidth
-        # saving — decode_attention groups query heads over it without
-        # materializing a repeat). The train/prefill compute paths repeat
-        # K/V up to the query head count first, so ring/all-to-all
-        # collectives DO ship full-width tensors; grouped ring/ulysses
-        # variants would be the further optimization.
+        # GQA: the CACHE stays at kv heads (decode_attention groups query
+        # heads over it — no repeated cache), and the RING variants
+        # rotate kv-width blocks (per-hop widen inside — the H/KV ICI
+        # saving). Everything else (dense/flash/ulysses) repeats K/V up
+        # front; a grouped ulysses would be the remaining optimization.
         rep = heads_local // kv_local
-        if not decode_step and rep > 1:
+        ring_kv_native = self.impl in ("ring", "ring_flash") and (
+            self.seq_axis is not None and self.seq_axis_size > 1
+        )
+        if not decode_step and rep > 1 and not ring_kv_native:
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         if decode_step:

@@ -106,6 +106,15 @@ def decode_attention(
     return out.reshape(b, one, hq, d)
 
 
+def _kv_group(q, k):
+    """GQA head grouping for the ring variants: query heads must be a
+    multiple of KV heads; returns the repeat factor."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    return hq // hkv
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -117,17 +126,24 @@ def ring_attention(
 ) -> jax.Array:
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
-    Call under ``shard_map`` with q/k/v of shape [B, T_local, H, D]
-    (T_local = T_global / axis_size, sharded along ``axis_name``).
-    At ring step s each device holds the K/V block originally owned by
-    device ``(idx - s) mod axis_size``, folds it into flash-style running
-    accumulators (block max ``m``, normalizer ``l``, unnormalized output
-    ``o``), and passes the block one neighbor up the ring —
-    ``axis_size - 1`` single-hop ``ppermute``s total, the
+    Call under ``shard_map`` with q of shape [B, T_local, H, D] and k/v
+    [B, T_local, Hkv, D] (T_local = T_global / axis_size, sharded along
+    ``axis_name``; Hkv may divide H — grouped-query attention, in which
+    case the blocks ROTATE at kv width, an H/Hkv ICI saving, and repeat
+    per hop for compute). At ring step s each device holds the K/V block
+    originally owned by device ``(idx - s) mod axis_size``, folds it into
+    flash-style running accumulators (block max ``m``, normalizer ``l``,
+    unnormalized output ``o``), and passes the block one neighbor up the
+    ring — ``axis_size - 1`` single-hop ``ppermute``s total, the
     ``part2a_extra`` p2p pattern doing real long-context work.
     """
+    rep = _kv_group(q, k)
+
+    def widen(x):
+        return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+
     if axis_size == 1:
-        return dense_attention(q, k, v, causal=causal)
+        return dense_attention(q, widen(k), widen(v), causal=causal)
 
     b, t_local, h, d = q.shape
     idx = lax.axis_index(axis_name)
@@ -143,11 +159,12 @@ def ring_attention(
 
     def step(s, carry):
         kb, vb, m, l, o = carry
+        kb_w, vb_w = widen(kb), widen(vb)
         # Global offset of the K/V block currently held: its home device.
         k_off = ((idx - s) % axis_size) * t_local
         scores = (
             jnp.einsum(
-                "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
+                "bqhd,bkhd->bhqk", q, kb_w, preferred_element_type=jnp.float32
             )
             * scale
         )
@@ -160,7 +177,7 @@ def ring_attention(
         p = jnp.exp(scores - m_new[..., None])
         l_new = correction * l + p.sum(axis=-1)
         pv = jnp.einsum(
-            "bhqk,bkhd->bqhd", p.astype(vb.dtype), vb,
+            "bhqk,bkhd->bqhd", p.astype(vb_w.dtype), vb_w,
             preferred_element_type=jnp.float32,
         )
         o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
@@ -230,6 +247,7 @@ def _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret):
     )
 
     b, t, h, d = q.shape
+    rep = _kv_group(q, k)
     idx = lax.axis_index(axis_name)
     up = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
@@ -242,8 +260,11 @@ def _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret):
 
         def compute(hop_causal):
             def fn(_):
+                # GQA: blocks rotate at kv width; widen per hop.
+                kb_w = jnp.repeat(kb, rep, axis=2) if rep > 1 else kb
+                vb_w = jnp.repeat(vb, rep, axis=2) if rep > 1 else vb
                 out_h, lse_h = flash_forward_lse(
-                    q, kb, vb, hop_causal, interpret=interpret
+                    q, kb_w, vb_w, hop_causal, interpret=interpret
                 )
                 return _to_bh(out_h, b, t, h, d).astype(jnp.float32), lse_h
 
@@ -284,11 +305,23 @@ def _rfa_bwd(axis_name, axis_size, causal, interpret, residuals, g):
     )
 
     q, k, v, out, lse = residuals
+    rep = _kv_group(q, k)
     idx = lax.axis_index(axis_name)
     up = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     delta = flash_delta(out, g)
 
     dq0 = jnp.zeros_like(q, jnp.float32)
+
+    def widen(x):
+        return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+
+    def narrow_grad(gx):
+        # Transpose of the head repeat: sum each query-head group's grad
+        # back onto its shared KV head.
+        if rep == 1:
+            return gx
+        b_, t_, hq, d_ = gx.shape
+        return gx.reshape(b_, t_, hq // rep, rep, d_).sum(axis=3)
 
     def hop(s, carry):
         kb, vb, dk_acc, dv_acc, dq_acc = carry
@@ -297,7 +330,8 @@ def _rfa_bwd(axis_name, axis_size, causal, interpret, residuals, g):
         def dq_case(hop_causal):
             def fn(_):
                 return flash_dq(
-                    q, kb, vb, g, lse, delta, hop_causal, interpret=interpret
+                    q, widen(kb), widen(vb), g, lse, delta, hop_causal,
+                    interpret=interpret,
                 ).astype(jnp.float32)
 
             return fn
@@ -305,9 +339,13 @@ def _rfa_bwd(axis_name, axis_size, causal, interpret, residuals, g):
         def dkv_case(hop_causal):
             def fn(_):
                 dk_h, dv_h = flash_dkv(
-                    q, kb, vb, g, lse, delta, hop_causal, interpret=interpret
+                    q, widen(kb), widen(vb), g, lse, delta, hop_causal,
+                    interpret=interpret,
                 )
-                return dk_h.astype(jnp.float32), dv_h.astype(jnp.float32)
+                return (
+                    narrow_grad(dk_h.astype(jnp.float32)),
+                    narrow_grad(dv_h.astype(jnp.float32)),
+                )
 
             return fn
 
