@@ -1,0 +1,71 @@
+"""Decode throughput on the real chip: KV-cache generation, MHA vs GQA.
+
+Autoregressive decoding is bandwidth-bound on the KV cache; grouped-query
+attention shrinks the cache by H/KV. Measures generated tokens/sec for
+the jitted sampling loop (infer/generate.py). Run: python
+benchmarks/bench_generate.py
+
+Measured 2026-07-30 (one TPU v5e chip, this config, greedy):
+  kv_heads=8 (MHA)   61.9 ms/gen   66.1k tokens/sec
+  kv_heads=2 (GQA)   38.7 ms/gen  105.9k tokens/sec  (1.60x)
+  kv_heads=1 (MQA)   39.8 ms/gen  103.0k tokens/sec
+The grouped decode_attention reads the cache at kv width — the saving
+is real bandwidth, not just capacity; kv=1's tiny head tensors give a
+little back to layout overhead.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+
+BATCH = 16
+PROMPT = 128
+NEW = 256
+REPEATS = 5
+
+
+def main() -> None:
+    prompt = jax.random.randint(jax.random.key(0), (BATCH, PROMPT), 0, 32768)
+    for kv in (8, 2, 1):
+        model = TransformerLM(
+            vocab_size=32768,
+            num_layers=4,
+            num_heads=8,
+            num_kv_heads=kv,
+            d_model=512,
+            d_ff=2048,
+            max_seq_len=PROMPT + NEW,
+            dtype=jnp.bfloat16,
+            attention_impl="dense",
+            use_rope=True,
+        )
+        params = model.init(
+            jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        generate = make_generator(model, max_new_tokens=NEW, temperature=0.0)
+
+        out = generate(params, prompt, jax.random.key(2))  # compile
+        float(out[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(REPEATS):
+            out = generate(params, prompt, jax.random.key(2))
+        float(out[0, 0])  # value fetch fences (see bench.py)
+        dt = (time.perf_counter() - t0) / REPEATS
+        print(
+            f"kv_heads={kv}  {dt * 1e3:8.1f} ms/gen  "
+            f"{BATCH * NEW / dt:10.0f} tokens/sec"
+        )
+
+
+if __name__ == "__main__":
+    main()
