@@ -86,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "default is the synthetic cyclic token stream")
     p.add_argument("--num-seqs", type=int, default=512,
                    help="synthetic stream size / corpus window cap")
+    p.add_argument("--eval-frac", type=float, default=0.0,
+                   help="hold out this fraction of sequences and report "
+                        "final loss/perplexity on them")
     # generation
     p.add_argument("--generate", type=int, default=0, metavar="N",
                    help="after training, sample N tokens")
@@ -163,11 +166,31 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
+    eval_tokens = None
+    if args.eval_frac > 0:
+        if not 0.0 < args.eval_frac < 1.0:
+            raise SystemExit(f"--eval-frac must be in (0, 1), got {args.eval_frac}")
+        n_eval = max(int(len(tokens) * args.eval_frac), cfg.global_batch_size)
+        if n_eval >= len(tokens):
+            raise SystemExit(
+                f"--eval-frac {args.eval_frac} leaves no training data "
+                f"({n_eval} of {len(tokens)} sequences held out)"
+            )
+        eval_tokens, tokens = tokens[:n_eval], tokens[n_eval:]
+
     trainer = LMTrainer(cfg)
     params, _, losses = trainer.fit(tokens, steps=args.steps)
     for i, loss in enumerate(losses):
         if i % args.log_every == 0 or i == len(losses) - 1:
             print(f"{i} loss:  {loss:f}")
+
+    eval_metrics = None
+    if eval_tokens is not None:
+        eval_metrics = trainer.evaluate(params, eval_tokens)
+        print(
+            f"eval loss:  {eval_metrics['loss']:f}  "
+            f"perplexity:  {eval_metrics['perplexity']:f}"
+        )
 
     sample_text = None
     sample_ids = None
@@ -233,6 +256,7 @@ def main(argv: list[str] | None = None) -> int:
                     "steps": args.steps,
                     "first_loss": losses[0] if losses else None,
                     "final_loss": losses[-1] if losses else None,
+                    "eval": eval_metrics,
                     "sample": sample_text or sample_ids,
                 }
             )

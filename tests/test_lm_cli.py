@@ -43,6 +43,20 @@ def test_lm_cli_byte_corpus(tmp_path, capsys):
     assert isinstance(summary["sample"], str) and len(summary["sample"]) == 6
 
 
+def test_lm_cli_eval_split(capsys):
+    rc = main(TINY + [
+        "--vocab-size", "32", "--data-parallel", "2", "--seq-parallel", "2",
+        "--num-seqs", "24", "--eval-frac", "0.25", "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["eval"] is not None
+    assert np.isfinite(summary["eval"]["loss"])
+    assert summary["eval"]["perplexity"] == pytest.approx(
+        np.exp(summary["eval"]["loss"]), rel=1e-5
+    )
+
+
 def test_byte_corpus_windows(tmp_path):
     f = tmp_path / "c.bin"
     f.write_bytes(bytes(range(100)))
