@@ -57,6 +57,39 @@ def test_gradients_match_dense(qkv):
         )
 
 
+@pytest.mark.parametrize("t", [24, 40, 96, 160])
+def test_odd_lengths_pick_divisor_blocks(t):
+    """Sequence lengths that don't divide the default 512/1024 blocks:
+    _pick_block must find a working divisor, forward AND backward."""
+    ks = jax.random.split(jax.random.key(t), 3)
+    q, k, v = (jax.random.normal(kk, (1, t, 2, 8)) for kk in ks)
+    expected = np.asarray(dense_attention(q, k, v, causal=True))
+    got = np.asarray(flash_attention(q, k, v, True, interpret=True))
+    np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
+
+    g_f = jax.grad(
+        lambda a: (flash_attention(a, k, v, True, interpret=True) ** 2).sum()
+    )(q)
+    g_d = jax.grad(
+        lambda a: (dense_attention(a, k, v, causal=True) ** 2).sum()
+    )(q)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_d),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_prime_length_rejected_loudly():
+    """A prime T larger than the block size has no usable divisor — the
+    kernel refuses instead of silently crawling one padded row per grid
+    step. (Primes BELOW the block size are fine: the whole sequence is
+    one block.)"""
+    q = jnp.zeros((1, 1031, 2, 8))  # prime > 512
+    with pytest.raises(ValueError, match="block"):
+        flash_attention(q, q, q, True, interpret=True)
+    small = jnp.zeros((1, 37, 2, 8))  # prime < block: single-block path
+    out = flash_attention(small, small, small, True, interpret=True)
+    assert out.shape == small.shape
+
+
 def test_bfloat16_inputs(qkv):
     q, k, v = (a.astype(jnp.bfloat16) for a in qkv)
     expected = np.asarray(
