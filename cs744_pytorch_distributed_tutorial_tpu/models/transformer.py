@@ -253,15 +253,16 @@ class Attention(nn.Module):
             else default_flash_interpret()
         )
         # GQA: the CACHE stays at kv heads (decode_attention groups query
-        # heads over it — no repeated cache), and the RING variants
-        # rotate kv-width blocks (per-hop widen inside — the H/KV ICI
-        # saving). Everything else (dense/flash/ulysses) repeats K/V up
-        # front; a grouped ulysses would be the remaining optimization.
+        # heads over it — no repeated cache), and the sequence-parallel
+        # variants take kv-width K/V directly: ring rotates kv-width
+        # blocks (per-hop widen inside), ulysses runs its K/V all_to_alls
+        # at kv width when divisible — the H/KV ICI saving. Only the
+        # single-device dense/flash paths repeat up front.
         rep = heads_local // kv_local
-        ring_kv_native = self.impl in ("ring", "ring_flash") and (
-            self.seq_axis is not None and self.seq_axis_size > 1
-        )
-        if not decode_step and rep > 1 and not ring_kv_native:
+        sp_kv_native = self.impl in (
+            "ring", "ring_flash", "ulysses", "ulysses_flash"
+        ) and (self.seq_axis is not None and self.seq_axis_size > 1)
+        if not decode_step and rep > 1 and not sp_kv_native:
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
         if decode_step:

@@ -405,9 +405,17 @@ def ulysses_attention(
     sees the FULL sequence starting at position 0, so no offset masking
     is needed. The on-chip/between-chip composition: all_to_all moves
     the data, the kernel does the math.
+
+    GQA: k/v may arrive at kv width (Hkv dividing H). When Hkv is also
+    divisible by the axis, the K/V all_to_alls run at kv width (the
+    H/Hkv ICI saving) and heads widen after; otherwise they widen first.
     """
     if inner not in ("dense", "flash"):
         raise ValueError(f"unknown inner attention {inner!r}")
+    rep = _kv_group(q, k)
+
+    def widen(x):
+        return jnp.repeat(x, rep, axis=2) if rep > 1 else x
 
     def local_attention(qg, kg, vg):
         if inner == "flash":
@@ -421,7 +429,7 @@ def ulysses_attention(
         return dense_attention(qg, kg, vg, causal=causal)
 
     if axis_size == 1:
-        return local_attention(q, k, v)
+        return local_attention(q, widen(k), widen(v))
     h = q.shape[2]
     if h % axis_size:
         raise ValueError(
@@ -439,6 +447,11 @@ def ulysses_attention(
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
-    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if rep > 1 and k.shape[2] % axis_size == 0:
+        # kv-width collectives: split kv heads over the axis, widen after.
+        kg, vg = widen(seq_to_heads(k)), widen(seq_to_heads(v))
+    else:
+        kg, vg = seq_to_heads(widen(k)), seq_to_heads(widen(v))
+    qg = seq_to_heads(q)
     out = local_attention(qg, kg, vg)  # full seq, head group
     return heads_to_seq(out)

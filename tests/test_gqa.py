@@ -121,6 +121,42 @@ def test_gqa_ring_rotates_kv_width_and_matches_dense():
         )
 
 
+@pytest.mark.parametrize("kv,inner", [(2, "dense"), (2, "flash"), (4, "dense"),
+                                      (1, "dense")])
+def test_gqa_ulysses_matches_dense(kv, inner):
+    """Ulysses with kv-width K/V (a2a at kv width when kv%axis==0, else
+    widen-first) matches dense on repeated heads."""
+    from jax.sharding import PartitionSpec as P
+
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        dense_attention,
+        ulysses_attention,
+    )
+
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    ks = jax.random.split(jax.random.key(kv), 3)
+    q = jax.random.normal(ks[0], (2, 16, 8, 8))
+    k = jax.random.normal(ks[1], (2, 16, kv, 8))
+    v = jax.random.normal(ks[2], (2, 16, kv, 8))
+    grp = 8 // kv
+    expected = np.asarray(dense_attention(
+        q, jnp.repeat(k, grp, 2), jnp.repeat(v, grp, 2), causal=True
+    ))
+    mapped = jax.shard_map(
+        lambda a, b, c: ulysses_attention(
+            a, b, c, "data", 2, causal=True, inner=inner, flash_interpret=True
+        ),
+        mesh=mesh,
+        in_specs=(P(None, "data"),) * 3,
+        out_specs=P(None, "data"),
+        check_vma=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(jax.jit(mapped)(q, k, v)), expected, rtol=2e-5, atol=2e-5
+    )
+
+
 def test_gqa_trains_seq_parallel_and_generates():
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
