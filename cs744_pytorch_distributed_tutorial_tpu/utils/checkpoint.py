@@ -46,18 +46,28 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
         )
 
-    def save(self, state: Any, *, force: bool = False) -> None:
+    def save(self, state: Any, *, force: bool = False, wait: bool = False) -> None:
+        """Persist ``state`` keyed by its step. ASYNC by default: the
+        device->host copy happens before returning (so the training loop
+        may immediately donate/overwrite the live buffers), while
+        serialization and disk I/O proceed on Orbax's background thread —
+        the train loop never stalls on the filesystem. Orbax commits a
+        step atomically, so a crash mid-write never leaves a readable
+        half-checkpoint; ``restore_latest``/``close`` synchronize first.
+        ``wait=True`` blocks until durable (tests, final saves)."""
         step = int(jax.device_get(state.step))
         if force and self.manager.latest_step() == step:
             return  # already saved at this step
         self.manager.save(step, args=self._ocp.args.StandardSave(state))
-        self.manager.wait_until_finished()
+        if wait:
+            self.manager.wait_until_finished()
 
     def restore_latest(self, template: Any) -> Any | None:
         """Restore the newest checkpoint into ``template``'s structure and
         shardings; None if the directory has no checkpoints. Leaves whose
         SAVED leading axis differs from the template's (a different world
         size) are resized — slice down, or tile cyclically up."""
+        self.manager.wait_until_finished()  # in-flight saves land first
         step = self.manager.latest_step()
         if step is None:
             return None
@@ -107,4 +117,5 @@ class Checkpointer:
         return jax.tree.map(adapt, raw, template)
 
     def close(self) -> None:
+        self.manager.wait_until_finished()
         self.manager.close()
