@@ -41,6 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--imagenet-stem", action="store_true", default=None,
                    help="force the 7x7/stride-2 + maxpool ResNet stem")
+    p.add_argument("--sync-bn", action="store_true", default=None,
+                   help="cross-replica BatchNorm statistics (default: the "
+                        "reference's per-replica BN)")
     p.add_argument("--num-devices", type=int, default=None)
     p.add_argument("--global-batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
@@ -118,6 +121,7 @@ _ARG_TO_FIELD = {
     "image_size": "image_size",
     "num_classes": "num_classes",
     "imagenet_stem": "imagenet_stem",
+    "sync_bn": "sync_bn",
     "num_devices": "num_devices",
     "global_batch_size": "global_batch_size",
     "epochs": "epochs",

@@ -46,6 +46,10 @@ class TrainConfig:
     # <= 64, ImageNet 7x7/stride-2 + maxpool above); True/False forces.
     # Ignored by non-ResNet models.
     imagenet_stem: bool | None = None
+    # SyncBN: compute BatchNorm batch statistics ACROSS data-parallel
+    # replicas (one psum per BN layer). False reproduces the reference's
+    # per-replica BN (DDP default; SURVEY §7 hard part b).
+    sync_bn: bool = False
     data_root: str = "./data"
     synthetic_data: bool | None = None  # None = auto (synthetic if no local CIFAR-10)
     synthetic_train_size: int = 50_000

@@ -46,6 +46,7 @@ class TinyCNN(nn.Module):
 
     num_classes: int = 10
     dtype: Any = jnp.float32
+    bn_axis: str | None = None  # SyncBN mesh axis; None = per-replica BN
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -53,7 +54,8 @@ class TinyCNN(nn.Module):
         for feat in (8, 16):
             x = nn.Conv(feat, (3, 3), padding="SAME", dtype=self.dtype)(x)
             x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                             epsilon=1e-5, dtype=self.dtype)(x)
+                             epsilon=1e-5, dtype=self.dtype,
+                             axis_name=self.bn_axis)(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))

@@ -28,12 +28,13 @@ class BasicBlock(nn.Module):
     features: int
     strides: int = 1
     dtype: Any = jnp.float32
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         norm = partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis,
         )
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
 
@@ -58,12 +59,13 @@ class BottleneckBlock(nn.Module):
     features: int
     strides: int = 1
     dtype: Any = jnp.float32
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         norm = partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis,
         )
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
 
@@ -91,13 +93,14 @@ class ResNet(nn.Module):
     num_classes: int = 10
     cifar_stem: bool = True
     dtype: Any = jnp.float32
+    bn_axis: str | None = None  # SyncBN mesh axis; None = per-replica BN
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         x = x.astype(self.dtype)
         norm = partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=self.dtype,
+            epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis,
         )
         if self.cifar_stem:
             x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
@@ -115,7 +118,8 @@ class ResNet(nn.Module):
             for b in range(n_blocks):
                 strides = 2 if stage > 0 and b == 0 else 1
                 x = self.block(features=64 * 2 ** stage, strides=strides,
-                               dtype=self.dtype)(x, train=train)
+                               dtype=self.dtype, bn_axis=self.bn_axis)(
+                                   x, train=train)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -53,6 +53,9 @@ class VGG(nn.Module):
     cfg: Sequence[Any]
     num_classes: int = 10
     dtype: Any = jnp.float32
+    # SyncBN: a mesh axis name computes batch statistics ACROSS replicas
+    # (flax's axis_name psum). None = the reference's per-replica BN.
+    bn_axis: str | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -74,6 +77,7 @@ class VGG(nn.Module):
                     momentum=0.9,
                     epsilon=1e-5,
                     dtype=self.dtype,
+                    axis_name=self.bn_axis,
                 )(x)
                 x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))  # 1x1x512 -> 512 for 32x32 inputs

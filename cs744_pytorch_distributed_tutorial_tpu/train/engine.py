@@ -122,6 +122,15 @@ class Trainer:
                 else cfg.imagenet_stem
             )
             model_kw["cifar_stem"] = not use_imagenet_stem
+        if cfg.sync_bn:
+            if not (
+                cfg.model.startswith(("vgg", "resnet")) or cfg.model == "tiny_cnn"
+            ):
+                raise ValueError(
+                    f"sync_bn applies to BatchNorm models only; {cfg.model!r} "
+                    "has no BN layers"
+                )
+            model_kw["bn_axis"] = DATA_AXIS
         self.model = get_model(
             cfg.model,
             num_classes=cfg.num_classes,

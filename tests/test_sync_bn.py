@@ -1,0 +1,70 @@
+"""SyncBN (TrainConfig.sync_bn): cross-replica batch statistics.
+
+The reference's DP keeps BN statistics local per rank (DDP default;
+manual parts never sync buffers — SURVEY §7 hard part b), which this
+framework reproduces by default. sync_bn=True is the capability
+addition: statistics psum across the data axis, so every replica's
+running stats stay identical."""
+
+import jax
+import numpy as np
+import pytest
+from conftest import TINY_DP4_CFG, run_tiny_dp4_steps
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+
+def _stats_shards(state):
+    leaf = jax.tree.leaves(state.batch_stats)[0]  # [num_devices, ...]
+    return np.asarray(jax.device_get(leaf))
+
+
+def test_sync_bn_makes_replica_stats_identical(mesh4):
+    """With sync_bn every replica computes the SAME batch statistics, so
+    the per-replica running-stats rows converge; local BN's rows differ
+    (each replica saw a different shard)."""
+    _, _, st_local = run_tiny_dp4_steps("allreduce", mesh4, steps=3)
+    local = _stats_shards(st_local)
+    assert not np.allclose(local[0], local[1]), "local BN rows should differ"
+
+    _, _, st_sync = run_tiny_dp4_steps(
+        "allreduce", mesh4, cfg_overrides={"sync_bn": True}, steps=3
+    )
+    sync = _stats_shards(st_sync)
+    for row in sync[1:]:
+        np.testing.assert_allclose(row, sync[0], rtol=1e-6)
+
+
+def test_sync_bn_single_device_matches_local(mesh4):
+    """On a 1-sized axis the psum is the identity: sync_bn == local BN
+    bit-for-bit (the reference semantics are untouched)."""
+    import jax.numpy as jnp
+
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+
+    mesh1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    ds = synthetic_cifar10(16, 4, seed=0)
+    losses = {}
+    for sync_bn in (False, True):
+        cfg = TrainConfig(model="tiny_cnn", sync="auto", num_devices=1,
+                          global_batch_size=16, synthetic_data=True,
+                          sync_bn=sync_bn)
+        tr = Trainer(cfg, mesh=mesh1)
+        state = tr.init()
+        x, y = shard_global_batch(mesh1, ds.train_images, ds.train_labels)
+        state, m = tr.train_step(state, x, y, jax.random.key(0))
+        losses[sync_bn] = float(m["loss"])
+    assert losses[True] == losses[False]
+
+
+def test_sync_bn_rejected_for_bn_free_models(mesh4):
+    with pytest.raises(ValueError, match="no BN"):
+        Trainer(
+            TrainConfig(**{**TINY_DP4_CFG, "model": "vit_tiny"}, sync_bn=True),
+            mesh=mesh4,
+        )
