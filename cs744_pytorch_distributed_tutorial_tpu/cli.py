@@ -44,6 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-bn", action="store_true", default=None,
                    help="cross-replica BatchNorm statistics (default: the "
                         "reference's per-replica BN)")
+    p.add_argument("--dropout", dest="dropout_rate", type=float, default=None,
+                   help="dropout rate (ViT family)")
     p.add_argument("--num-devices", type=int, default=None)
     p.add_argument("--global-batch-size", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
@@ -122,6 +124,7 @@ _ARG_TO_FIELD = {
     "num_classes": "num_classes",
     "imagenet_stem": "imagenet_stem",
     "sync_bn": "sync_bn",
+    "dropout_rate": "dropout_rate",
     "num_devices": "num_devices",
     "global_batch_size": "global_batch_size",
     "epochs": "epochs",

@@ -50,6 +50,9 @@ class TrainConfig:
     # replicas (one psum per BN layer). False reproduces the reference's
     # per-replica BN (DDP default; SURVEY §7 hard part b).
     sync_bn: bool = False
+    # Dropout for models that support it (the ViT family); conv models
+    # follow the reference and have none.
+    dropout_rate: float = 0.0
     data_root: str = "./data"
     synthetic_data: bool | None = None  # None = auto (synthetic if no local CIFAR-10)
     synthetic_train_size: int = 50_000

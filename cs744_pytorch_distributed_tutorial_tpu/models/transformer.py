@@ -330,6 +330,10 @@ class Block(nn.Module):
     rope: bool = False
     rope_base: float = 10000.0
     num_kv_heads: int | None = None
+    # Residual dropout on the attention and MLP sublayer outputs. Active
+    # only when the CALLER passes deterministic=False (and supplies a
+    # 'dropout' rng); rate 0.0 is a no-op either way.
+    dropout_rate: float = 0.0
 
     @nn.compact
     def __call__(
@@ -338,6 +342,7 @@ class Block(nn.Module):
         *,
         mode: str = "train",
         decode_pos: jnp.ndarray | None = None,
+        deterministic: bool = True,
     ) -> jnp.ndarray:
         tp = self.tensor_axis is not None and self.tensor_axis_size > 1
         # The MoE path never shards d_ff over the tensor axis (experts
@@ -350,8 +355,11 @@ class Block(nn.Module):
             )
         d_ff_local = self.d_ff // self.tensor_axis_size if tp else self.d_ff
 
+        drop = partial(
+            nn.Dropout, rate=self.dropout_rate, deterministic=deterministic
+        )
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        x = x + Attention(
+        attn_out = Attention(
             num_heads=self.num_heads,
             dtype=self.dtype,
             impl=self.impl,
@@ -367,6 +375,9 @@ class Block(nn.Module):
             num_kv_heads=self.num_kv_heads,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
+        if self.dropout_rate > 0.0:
+            attn_out = drop(name="attn_drop")(attn_out)
+        x = x + attn_out
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         if self.num_experts > 0:
             from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN
@@ -396,6 +407,8 @@ class Block(nn.Module):
         h = nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="mlp_out")(
             h
         )
+        if self.dropout_rate > 0.0:
+            h = drop(name="mlp_drop")(h)
         if tp:
             h = reduce_from_tp_region(h, self.tensor_axis)
         bias = self.param(

@@ -34,6 +34,9 @@ class ViT(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "dense"  # "flash" routes through the Pallas kernel
     flash_interpret: bool | None = None
+    # Dropout on position embeddings + each block's sublayer outputs;
+    # active in train mode (the engine supplies the 'dropout' rng).
+    dropout_rate: float = 0.0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -66,6 +69,9 @@ class ViT(nn.Module):
             (1, n + 1, self.d_model),
         )
         x = x + pos.astype(self.dtype)
+        if self.dropout_rate > 0.0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train,
+                           name="pos_drop")(x)
 
         for i in range(self.num_layers):
             x = Block(
@@ -75,8 +81,9 @@ class ViT(nn.Module):
                 impl=self.attention_impl,
                 causal=False,
                 flash_interpret=self.flash_interpret,
+                dropout_rate=self.dropout_rate,
                 name=f"block_{i}",
-            )(x)
+            )(x, deterministic=not train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(
             x[:, 0]

@@ -131,6 +131,13 @@ class Trainer:
                     "has no BN layers"
                 )
             model_kw["bn_axis"] = DATA_AXIS
+        if cfg.dropout_rate:
+            if not cfg.model.startswith("vit"):
+                raise ValueError(
+                    f"dropout_rate applies to the ViT family; {cfg.model!r} "
+                    "follows the reference (no dropout)"
+                )
+            model_kw["dropout_rate"] = cfg.dropout_rate
         self.model = get_model(
             cfg.model,
             num_classes=cfg.num_classes,
@@ -261,7 +268,7 @@ class Trainer:
 
         accum = cfg.accum_steps
 
-        def microbatch_grads(params, local_stats, x, labels):
+        def microbatch_grads(params, local_stats, x, labels, drop_key):
             """One fwd/bwd on an (augmented) local microbatch under the
             configured sync strategy: (loss, local_loss, grads, stats)."""
 
@@ -271,6 +278,7 @@ class Trainer:
                     x,
                     train=True,
                     mutable=["batch_stats"],
+                    rngs={"dropout": drop_key},
                 )
                 loss = _smoothed_xent(logits, labels, cfg.label_smoothing)
                 return loss, mutated["batch_stats"]
@@ -311,12 +319,13 @@ class Trainer:
             key = jax.random.fold_in(base_key, state.step)
             key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
             x = augment_train_batch(key, images)
+            drop_key = jax.random.fold_in(key, 7)
 
             local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
 
             if accum == 1:
                 loss, local_loss, grads, new_stats = microbatch_grads(
-                    state.params, local_stats, x, labels
+                    state.params, local_stats, x, labels, drop_key
                 )
             else:
                 # Gradient accumulation: scan over microbatches — only ONE
@@ -328,11 +337,12 @@ class Trainer:
                 # unaccumulated step; BN-free models match exactly.
                 xm = x.reshape(accum, -1, *x.shape[1:])
                 ym = labels.reshape(accum, -1)
+                mb_keys = jax.random.split(drop_key, accum)
 
                 def body(carry, mb):
                     g_sum, l_sum, ll_sum, stats = carry
                     loss, ll, g, stats = microbatch_grads(
-                        state.params, stats, mb[0], mb[1]
+                        state.params, stats, mb[0], mb[1], mb[2]
                     )
                     return (
                         jax.tree.map(jnp.add, g_sum, g),
@@ -350,7 +360,7 @@ class Trainer:
                 # shard_map's replication analysis.
                 zero_var = lax.pcast(zero, DATA_AXIS, to="varying")
                 (g_sum, l_sum, ll_sum, new_stats), _ = lax.scan(
-                    body, (zeros, zero, zero_var, local_stats), (xm, ym)
+                    body, (zeros, zero, zero_var, local_stats), (xm, ym, mb_keys)
                 )
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 loss = l_sum / accum

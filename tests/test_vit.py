@@ -40,6 +40,45 @@ def test_vit_flash_matches_dense():
     )
 
 
+def test_vit_dropout_trains_and_eval_is_deterministic(mesh4):
+    """dropout_rate > 0: training runs (engine supplies the rng), the
+    trajectory differs from rate 0, and eval stays deterministic."""
+    import jax.numpy as jnp
+
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+
+    ds = synthetic_cifar10(16, 8, seed=0)
+    params = {}
+    for rate in (0.0, 0.3):
+        cfg = TrainConfig(model="vit_tiny", sync="auto", num_devices=4,
+                          global_batch_size=16, synthetic_data=True,
+                          dropout_rate=rate)
+        tr = Trainer(cfg, mesh=mesh4)
+        state = tr.init()
+        x, y = shard_global_batch(mesh4, ds.train_images, ds.train_labels)
+        for _ in range(2):
+            state, m = tr.train_step(state, x, y, jax.random.key(0))
+        assert np.isfinite(float(m["loss"]))
+        params[rate] = state.params
+        if rate > 0:
+            xt, yt = shard_global_batch(mesh4, ds.test_images, ds.test_labels)
+            mask = shard_global_batch(mesh4, np.ones(8, np.float32))
+            e1 = tr.eval_step(state, xt, yt, mask)
+            e2 = tr.eval_step(state, xt, yt, mask)
+            assert float(e1["loss_sum"]) == float(e2["loss_sum"])
+    a = jax.tree.leaves(jax.device_get(params[0.0]))
+    b = jax.tree.leaves(jax.device_get(params[0.3]))
+    assert any(not np.allclose(x_, y_) for x_, y_ in zip(a, b))
+
+    with pytest.raises(ValueError, match="dropout"):
+        Trainer(TrainConfig(model="vgg11", num_devices=4,
+                            global_batch_size=16, dropout_rate=0.1,
+                            synthetic_data=True), mesh=mesh4)
+
+
 def test_vit_trains_distributed(mesh4):
     """ViT under the same DP engine as VGG/ResNet: finite losses, empty
     per-replica batch_stats, eval runs."""
