@@ -131,6 +131,10 @@ class Trainer:
                     "has no BN layers"
                 )
             model_kw["bn_axis"] = DATA_AXIS
+        if not 0.0 <= cfg.dropout_rate < 1.0:
+            raise ValueError(
+                f"dropout_rate must be in [0, 1), got {cfg.dropout_rate}"
+            )
         if cfg.dropout_rate:
             if not cfg.model.startswith("vit"):
                 raise ValueError(

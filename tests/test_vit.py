@@ -43,8 +43,6 @@ def test_vit_flash_matches_dense():
 def test_vit_dropout_trains_and_eval_is_deterministic(mesh4):
     """dropout_rate > 0: training runs (engine supplies the rng), the
     trajectory differs from rate 0, and eval stays deterministic."""
-    import jax.numpy as jnp
-
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
     from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
         shard_global_batch,
@@ -77,6 +75,11 @@ def test_vit_dropout_trains_and_eval_is_deterministic(mesh4):
         Trainer(TrainConfig(model="vgg11", num_devices=4,
                             global_batch_size=16, dropout_rate=0.1,
                             synthetic_data=True), mesh=mesh4)
+    for bad in (1.0, -0.5):
+        with pytest.raises(ValueError, match="dropout_rate"):
+            Trainer(TrainConfig(model="vit_tiny", num_devices=4,
+                                global_batch_size=16, dropout_rate=bad,
+                                synthetic_data=True), mesh=mesh4)
 
 
 def test_vit_trains_distributed(mesh4):
