@@ -42,13 +42,11 @@ def make_beam_searcher(
     ``make_generator`` (``seq_axis=None``; params from any training mesh
     drop in).
     """
-    if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
-        raise ValueError("beam search needs a model with seq_axis=None")
-    if getattr(model, "tensor_axis", None) is not None and model.tensor_axis_size > 1:
-        raise ValueError(
-            "beam search does not run under tensor parallelism; construct a "
-            "decode copy with tensor_axis=None from gathered full params"
-        )
+    from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
+        check_decode_model,
+    )
+
+    check_decode_model(model, "beam search")
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
     if max_new_tokens < 1:

@@ -32,6 +32,22 @@ from jax import lax
 _NEG = -1e30  # additive mask: exp() underflows to exactly 0.0, no NaNs
 
 
+def check_decode_model(model: Any, what: str) -> None:
+    """Decoding runs outside shard_map: the model must have no sequence
+    or tensor mesh axes (scale over batch comes from jit's sharding).
+    Shared by the sampling generator and beam search."""
+    if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
+        raise ValueError(
+            f"{what} needs a model with seq_axis=None; construct a decode "
+            "copy of the model (same dims) — trained params drop in directly"
+        )
+    if getattr(model, "tensor_axis", None) is not None and model.tensor_axis_size > 1:
+        raise ValueError(
+            f"{what} does not run under tensor parallelism; construct a "
+            "decode copy with tensor_axis=None from gathered full params"
+        )
+
+
 def sample_tokens(
     logits: jax.Array,
     key: jax.Array,
@@ -98,16 +114,7 @@ def make_generator(
     ``max_new_tokens`` steps (static shapes); callers needing the speedup
     of a dynamic stop should shrink ``max_new_tokens`` instead.
     """
-    if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
-        raise ValueError(
-            "generation needs a model with seq_axis=None; construct a decode "
-            "copy of the model (same dims) — trained params drop in directly"
-        )
-    if getattr(model, "tensor_axis", None) is not None and model.tensor_axis_size > 1:
-        raise ValueError(
-            "generation does not run under tensor parallelism; construct a "
-            "decode copy with tensor_axis=None from gathered full params"
-        )
+    check_decode_model(model, "generation")
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
 

@@ -262,9 +262,12 @@ class Attention(nn.Module):
         sp_kv_native = self.impl in (
             "ring", "ring_flash", "ulysses", "ulysses_flash"
         ) and (self.seq_axis is not None and self.seq_axis_size > 1)
-        if not decode_step and rep > 1 and not sp_kv_native:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if not decode_step and not sp_kv_native:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+                repeat_kv,
+            )
+
+            k, v = repeat_kv(k, rep), repeat_kv(v, rep)
         if decode_step:
             out = decode_attention(q, ck.value, cv.value, decode_pos)
         elif self.seq_axis is None or self.seq_axis_size == 1:

@@ -98,6 +98,15 @@ def make_mesh(
     return Mesh(dev_array, names)
 
 
+def interpret_kernels(mesh: Mesh) -> bool:
+    """True when Pallas kernels must run in interpret mode for this mesh:
+    its devices are not a TPU backend ('tpu', or this environment's
+    'axon' plugin). Decided from the mesh the computation actually runs
+    on, not the global default backend — a TPU host can drive a CPU test
+    mesh."""
+    return {d.platform for d in mesh.devices.flat}.isdisjoint({"tpu", "axon"})
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for fully replicated values (params, opt state)."""
     return NamedSharding(mesh, P())

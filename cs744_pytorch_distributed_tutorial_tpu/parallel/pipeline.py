@@ -355,8 +355,11 @@ class PipelineLMTrainer:
                 f"unknown attention_impl {cfg.attention_impl!r}; the pipeline "
                 "engine supports 'dense' or 'flash'"
             )
-        platforms = {d.platform for d in self.mesh.devices.flat}
-        interpret = platforms.isdisjoint({"tpu", "axon"})
+        from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+            interpret_kernels,
+        )
+
+        interpret = interpret_kernels(self.mesh)
 
         def forward(params, tokens):
             b, t = tokens.shape

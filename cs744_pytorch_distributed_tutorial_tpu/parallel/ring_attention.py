@@ -115,6 +115,12 @@ def _kv_group(q, k):
     return hq // hkv
 
 
+def repeat_kv(x: jax.Array, rep: int) -> jax.Array:
+    """Widen [B, T, Hkv, D] KV heads to the query head count (the GQA
+    repeat; identity when rep == 1)."""
+    return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -138,9 +144,7 @@ def ring_attention(
     ``part2a_extra`` p2p pattern doing real long-context work.
     """
     rep = _kv_group(q, k)
-
-    def widen(x):
-        return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+    widen = lambda x: repeat_kv(x, rep)
 
     if axis_size == 1:
         return dense_attention(q, widen(k), widen(v), causal=causal)
@@ -261,8 +265,7 @@ def _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret):
         def compute(hop_causal):
             def fn(_):
                 # GQA: blocks rotate at kv width; widen per hop.
-                kb_w = jnp.repeat(kb, rep, axis=2) if rep > 1 else kb
-                vb_w = jnp.repeat(vb, rep, axis=2) if rep > 1 else vb
+                kb_w, vb_w = repeat_kv(kb, rep), repeat_kv(vb, rep)
                 out_h, lse_h = flash_forward_lse(
                     q, kb_w, vb_w, hop_causal, interpret=interpret
                 )
@@ -311,9 +314,7 @@ def _rfa_bwd(axis_name, axis_size, causal, interpret, residuals, g):
     delta = flash_delta(out, g)
 
     dq0 = jnp.zeros_like(q, jnp.float32)
-
-    def widen(x):
-        return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+    widen = lambda x: repeat_kv(x, rep)
 
     def narrow_grad(gx):
         # Transpose of the head repeat: sum each query-head group's grad
@@ -413,9 +414,7 @@ def ulysses_attention(
     if inner not in ("dense", "flash"):
         raise ValueError(f"unknown inner attention {inner!r}")
     rep = _kv_group(q, k)
-
-    def widen(x):
-        return jnp.repeat(x, rep, axis=2) if rep > 1 else x
+    widen = lambda x: repeat_kv(x, rep)
 
     def local_attention(qg, kg, vg):
         if inner == "flash":

@@ -71,6 +71,19 @@ from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
 from cs744_pytorch_distributed_tutorial_tpu.config import resolve_dtype
 
 
+def _load_dataset(cfg: TrainConfig):
+    """The config's dataset (real CIFAR-10 from disk or synthetic at the
+    configured shape) — shared by fit() and evaluate_only()."""
+    return load_cifar10(
+        cfg.data_root,
+        synthetic=cfg.synthetic_data,
+        synthetic_train_size=cfg.synthetic_train_size,
+        synthetic_test_size=cfg.synthetic_test_size,
+        image_size=cfg.image_size,
+        num_classes=cfg.num_classes,
+    )
+
+
 def _smoothed_xent(logits, labels, smoothing: float):
     """Mean CE against the (1-s) one-hot + s/K smoothed target. s=0 is
     exactly the reference's CrossEntropyLoss (verified vs torch)."""
@@ -189,14 +202,15 @@ class Trainer:
         elif cfg.fused_optimizer:
             from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
 
-            platforms = {d.platform for d in self.mesh.devices.flat}
-            # Mosaic-compile only on TPU backends ('tpu', or this
-            # environment's 'axon' plugin); interpret mode elsewhere.
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+                interpret_kernels,
+            )
+
             self.tx = FusedSGD(
                 cfg.learning_rate,
                 cfg.momentum,
                 cfg.weight_decay,
-                interpret=platforms.isdisjoint({"tpu", "axon"}),
+                interpret=interpret_kernels(self.mesh),
             )
         else:
             self.tx = make_optimizer(cfg)
@@ -524,14 +538,7 @@ class Trainer:
         timing window, eval summary after each epoch."""
         cfg = self.cfg
         if dataset is None:
-            dataset = load_cifar10(
-                cfg.data_root,
-                synthetic=cfg.synthetic_data,
-                synthetic_train_size=cfg.synthetic_train_size,
-                synthetic_test_size=cfg.synthetic_test_size,
-                image_size=cfg.image_size,
-                num_classes=cfg.num_classes,
-            )
+            dataset = _load_dataset(cfg)
         train_loader = BatchLoader(
             dataset.train_images,
             dataset.train_labels,
@@ -806,14 +813,7 @@ class Trainer:
         checkpoint dir this evaluates freshly initialized params."""
         cfg = self.cfg
         if dataset is None:
-            dataset = load_cifar10(
-                cfg.data_root,
-                synthetic=cfg.synthetic_data,
-                synthetic_train_size=cfg.synthetic_train_size,
-                synthetic_test_size=cfg.synthetic_test_size,
-                image_size=cfg.image_size,
-                num_classes=cfg.num_classes,
-            )
+            dataset = _load_dataset(cfg)
         test_loader = BatchLoader(
             dataset.test_images,
             dataset.test_labels,

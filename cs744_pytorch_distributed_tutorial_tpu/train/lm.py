@@ -33,6 +33,7 @@ from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
 )
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
+    interpret_kernels,
     make_mesh,
 )
 
@@ -235,11 +236,7 @@ class LMTrainer:
                 f"({self.data_size}) for expert parallelism"
             )
         dtype = resolve_dtype(cfg.compute_dtype)
-        # Interpret the Pallas flash kernel off-TPU, decided by the mesh
-        # the computation actually runs on (not the global default
-        # backend, which can differ on a TPU host driving a CPU mesh).
-        platforms = {d.platform for d in self.mesh.devices.flat}
-        flash_interpret = platforms.isdisjoint({"tpu", "axon"})
+        flash_interpret = interpret_kernels(self.mesh)
         self._flash_interpret = flash_interpret
         self.model = TransformerLM(
             vocab_size=cfg.vocab_size,
