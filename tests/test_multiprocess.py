@@ -1,0 +1,91 @@
+"""True multi-process rendezvous: two OS processes join through
+``parallel.mesh.initialize`` (the ``init_process`` mirror,
+``master/part2a/part2a.py:80-85``) and run a cross-process psum over a
+global array assembled with ``local_to_global_batch`` — the reference's
+4-CloudLab-node flow, on one machine. Every other test simulates
+multi-device single-process; this one exercises the actual coordination
+service + cross-process collective path."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    initialize, local_to_global_batch,
+)
+
+rank = int(sys.argv[1])
+initialize({coord!r}, 2, rank)  # the init_process mirror
+assert jax.process_count() == 2
+devices = jax.devices()
+assert len(devices) == 2, devices
+
+mesh = make_mesh({{"data": 2}}, devices=devices)
+# Each process contributes ITS shard of the global batch (the
+# DistributedSampler analog across hosts).
+local = np.full((2, 4), float(rank + 1), np.float32)
+global_batch = local_to_global_batch(mesh, local)
+assert global_batch.shape == (4, 4)
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+@jax.jit
+def global_sum(x):
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P())
+    ).sum()
+
+total = float(global_sum(global_batch))
+# rows: two of 1.0 (rank 0) + two of 2.0 (rank 1), 4 columns each
+assert total == 2 * 4 * 1.0 + 2 * 4 * 2.0, total
+print(f"rank {{rank}} ok total={{total}}")
+"""
+
+
+def test_two_process_rendezvous_and_cross_process_reduction(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with socket.socket() as s:  # free port for the coordination service
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+    script = _WORKER.format(repo=repo, coord=coord)
+
+    env = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",  # exactly one CPU device per process
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(rank)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=str(tmp_path),
+        )
+        for rank in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multi-process rendezvous hung; partial output: {outs}")
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank} ok" in out
