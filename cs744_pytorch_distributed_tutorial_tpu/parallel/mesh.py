@@ -138,10 +138,38 @@ def device_stats_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return batch_sharding(mesh, axis)
 
 
+def host_to_global(tree, sharding: NamedSharding):
+    """Place host values (each the FULL global array, identical on every
+    process) onto ``sharding`` — which may span processes. Single-process
+    (or fully addressable) this is ``device_put``; across processes each
+    host contributes the slices its addressable devices own via
+    ``jax.make_array_from_callback`` (``device_put`` rejects
+    non-addressable shardings outright — the multi-host placement bug
+    this helper exists to avoid)."""
+
+    def put(x):
+        if sharding.is_fully_addressable:
+            return jax.device_put(x, sharding)
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key
+        ):
+            # Typed PRNG keys can't round-trip through NumPy: place the
+            # underlying uint32 data, re-wrap with the same impl.
+            impl = jax.random.key_impl(x)
+            placed = put(np.asarray(jax.random.key_data(x)))
+            return jax.random.wrap_key_data(placed, impl=impl)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    return jax.tree.map(put, tree)
+
+
 def shard_global_batch(mesh: Mesh, *arrays: jax.Array | np.ndarray, axis: str = DATA_AXIS):
     """Place host arrays as data-sharded global jax.Arrays."""
     sharding = batch_sharding(mesh, axis)
-    out = tuple(jax.device_put(a, sharding) for a in arrays)
+    out = tuple(host_to_global(a, sharding) for a in arrays)
     return out[0] if len(out) == 1 else out
 
 

@@ -40,7 +40,11 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    DATA_AXIS,
+    host_to_global,
+    make_mesh,
+)
 from cs744_pytorch_distributed_tutorial_tpu.parallel.tensor import (
     copy_to_tp_region,
     reduce_from_tp_region,
@@ -340,7 +344,8 @@ class PipelineLMTrainer:
         params = self._init_host(self.cfg.seed if seed is None else seed)
         opt_state = self.tx.init(params)
         put = lambda tree, specs: jax.tree.map(
-            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), tree, specs
+            lambda x, s: host_to_global(x, NamedSharding(self.mesh, s)),
+            tree, specs,
         )
         return put(params, self.param_specs), put(opt_state, self.opt_specs)
 
@@ -432,8 +437,8 @@ class PipelineLMTrainer:
         """[B, seq_len + 1] host tokens -> (inputs, targets), data-sharded."""
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
         return (
-            jax.device_put(tokens[:, :-1], sharding),
-            jax.device_put(tokens[:, 1:], sharding),
+            host_to_global(tokens[:, :-1], sharding),
+            host_to_global(tokens[:, 1:], sharding),
         )
 
     def reference_forward(self, params_global, tokens):

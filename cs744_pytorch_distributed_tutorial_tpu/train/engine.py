@@ -52,6 +52,7 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
     batch_sharding,
     device_stats_sharding,
+    host_to_global,
     make_mesh,
     replicated,
 )
@@ -514,15 +515,18 @@ class Trainer:
         """Lay the state out on the mesh: replicated params, per-replica
         BN stats along the data axis; opt state replicated — except under
         zero1, whose momentum chunks shard over the data axis, and fsdp,
-        where params AND momentum live as data-axis-sharded flat chunks."""
+        where params AND momentum live as data-axis-sharded flat chunks.
+        Multi-host safe: placement routes through ``host_to_global``."""
         rep = replicated(self.mesh)
         dev = device_stats_sharding(self.mesh)
         sharded_opt = self._zero1 or self._fsdp
         return TrainState(
-            step=jax.device_put(state.step, rep),
-            params=jax.device_put(state.params, dev if self._fsdp else rep),
-            batch_stats=jax.device_put(state.batch_stats, dev),
-            opt_state=jax.device_put(state.opt_state, dev if sharded_opt else rep),
+            step=host_to_global(state.step, rep),
+            params=host_to_global(state.params, dev if self._fsdp else rep),
+            batch_stats=host_to_global(state.batch_stats, dev),
+            opt_state=host_to_global(
+                state.opt_state, dev if sharded_opt else rep
+            ),
         )
 
     # ------------------------------------------------------------------ loops
@@ -557,7 +561,7 @@ class Trainer:
         )
         if state is None:
             state = self.init()
-        base_key = jax.device_put(
+        base_key = host_to_global(
             jax.random.key(cfg.seed), replicated(self.mesh)
         )
 

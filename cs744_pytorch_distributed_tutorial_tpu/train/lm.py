@@ -33,6 +33,7 @@ from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
 )
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
+    host_to_global,
     interpret_kernels,
     make_mesh,
 )
@@ -506,12 +507,12 @@ class LMTrainer:
         opt_state = self.tx.init(params)
         mesh = self.mesh
         params = jax.tree.map(
-            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            lambda p, s: host_to_global(p, NamedSharding(mesh, s)),
             params,
             self.param_specs,
         )
         opt_state = jax.tree.map(
-            lambda o, s: jax.device_put(o, NamedSharding(mesh, s)),
+            lambda o, s: host_to_global(o, NamedSharding(mesh, s)),
             opt_state,
             self.opt_specs,
         )
@@ -526,8 +527,8 @@ class LMTrainer:
         targets = tokens[:, 1:]
         sharding = NamedSharding(self.mesh, P(DATA_AXIS, SEQ_AXIS))
         return (
-            jax.device_put(inputs, sharding),
-            jax.device_put(targets, sharding),
+            host_to_global(inputs, sharding),
+            host_to_global(targets, sharding),
         )
 
     def evaluate(self, params, tokens) -> dict[str, float]:
