@@ -7,8 +7,17 @@ wall-clock on 4-thread CPU ranks (``master/part1/part1.py:42-44``) — so
 the baseline here is the value this repo established in round 1 on one
 TPU v5e chip; ``vs_baseline`` tracks improvement against it.
 
+Round-2 changes:
+- the step is compiled with ``xla_tpu_scoped_vmem_limit_kib=65536``
+  (v5e has far more physical VMEM than the 16 MiB scoped default; the
+  larger budget lets XLA pick deeper fusions — measured ~7% step win);
+- the headline batch stays 4096 (round 1's scored point), and the
+  JSON line *also* reports the batch-1024 operating point (round 1's
+  baseline batch) so ``vs_baseline_b1024`` measures code, not batch
+  (VERDICT round 1, "normalize the benchmark baseline").
+
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
@@ -17,26 +26,51 @@ import json
 import time
 
 import jax
-import numpy as np
 
-# Round-1 measured value on one TPU v5 lite chip (bf16, global batch 1024,
-# sync='auto'). Later rounds benchmark against this. NOTE: the scored run
-# now uses GLOBAL_BATCH=4096 (below), so ~4% of vs_baseline comes from
-# that operating-point change, not code — at the baseline's batch 1024
-# this tree measures ~32.2k sps (vs_baseline ~1.49).
-ROUND1_BASELINE_SPS = 21_700.0
-
-# Batch 4096: measured sweep (512/1024/2048/4096/6144) shows per-chip
-# throughput rising ~4% from 1024 to 4096 and flat beyond — the step is
-# HBM-bandwidth-bound (XLA cost analysis: ~2.9 GF and ~16.4 KB accessed
-# per sample fwd+bwd), so larger batches only amortize fixed overheads.
-# 8192 exceeds the tunnel's compile transfer limit.
+# Round-1 measured values on one TPU v5e chip (bf16, sync='auto'):
+# 32,954.6 sps at the scored batch 4096; ~32.2k at batch 1024.
+ROUND1_BASELINE_SPS = 21_700.0  # the driver's original baseline
 GLOBAL_BATCH = 4096
+BATCH_SMALL = 1024
 WARMUP_STEPS = 5
 MEASURE_STEPS = 30
 
+# v5e: 128 MiB physical VMEM/core vs the 16 MiB scoped-allocation
+# default; a 64 MiB budget admits deeper fusions for the conv+BN step.
+COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
 
-def main() -> None:
+
+def _measure(trainer, state, x, y, key, steps: int) -> float:
+    """Steps/sec of the compiled per-step path. Each timing region is
+    closed by fetching a concrete scalar derived from the LAST step's
+    params: a host round-trip cannot complete before the dependent
+    computation does. ``block_until_ready`` alone is NOT a reliable
+    completion fence on this environment's tunneled TPU backend
+    (measured ~190x inflation in round 1)."""
+    if jax.default_backend() != "cpu":
+        # Compile failures must surface, not silently fall back — a
+        # default-compiled score would not be comparable to the
+        # documented vmem-option configuration.
+        fn = trainer.train_step.lower(state, x, y, key).compile(
+            compiler_options=COMPILER_OPTIONS
+        )
+    else:  # CPU smoke runs: the TPU option doesn't exist there
+        fn = trainer.train_step
+
+    def fence(s) -> None:
+        float(jax.tree.leaves(s.params)[0].ravel()[0])
+
+    for _ in range(WARMUP_STEPS):
+        state, _ = fn(state, x, y, key)
+    fence(state)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, _ = fn(state, x, y, key)
+    fence(state)
+    return steps / (time.perf_counter() - t0)
+
+
+def _bench_at(batch: int) -> float:
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
@@ -50,52 +84,33 @@ def main() -> None:
         model="resnet18",
         sync="auto",
         num_devices=n_chips,
-        global_batch_size=GLOBAL_BATCH,
+        global_batch_size=batch,
         compute_dtype="bfloat16",
         synthetic_data=True,
     )
     mesh = make_mesh({"data": n_chips})
     trainer = Trainer(cfg, mesh=mesh)
     state = trainer.init()
-
-    ds = synthetic_cifar10(GLOBAL_BATCH, 16, seed=0)
+    ds = synthetic_cifar10(batch, 16, seed=0)
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
+    sps = _measure(trainer, state, x, y, key, MEASURE_STEPS) * batch
+    return sps / n_chips
 
-    # Close each timing region by fetching a concrete scalar derived from
-    # the LAST step's params: a host round-trip cannot complete before the
-    # dependent computation — including that step's gradient sync and
-    # optimizer update — does. ``block_until_ready`` alone is NOT a
-    # reliable completion fence on this environment's tunneled TPU backend
-    # (measured: it returned after 21 ms for 30 steps that the value fetch
-    # showed actually took 3.98 s, a ~190x inflation). The in-graph
-    # multi-step path (``Trainer.train_steps``) is benchmarked on CPU
-    # meshes only for now: on this tunneled single-chip backend the
-    # scanned program wedges the tunnel (observed twice), so the scored
-    # number stays on the per-step dispatch path.
-    def fence(s) -> None:
-        float(jax.tree.leaves(s.params)[0].ravel()[0])
 
-    for _ in range(WARMUP_STEPS):
-        state, metrics = trainer.train_step(state, x, y, key)
-    fence(state)
-
-    t0 = time.perf_counter()
-    for _ in range(MEASURE_STEPS):
-        state, metrics = trainer.train_step(state, x, y, key)
-    fence(state)
-    elapsed = time.perf_counter() - t0
-
-    sps = GLOBAL_BATCH * MEASURE_STEPS / elapsed
-    sps_per_chip = sps / n_chips
-    vs = sps_per_chip / ROUND1_BASELINE_SPS
+def main() -> None:
+    sps_big = _bench_at(GLOBAL_BATCH)
+    sps_small = _bench_at(BATCH_SMALL)
     print(
         json.dumps(
             {
                 "metric": "cifar10_resnet18_train_samples_per_sec_per_chip",
-                "value": round(sps_per_chip, 1),
+                "value": round(sps_big, 1),
                 "unit": "samples/sec/chip",
-                "vs_baseline": round(vs, 3),
+                "vs_baseline": round(sps_big / ROUND1_BASELINE_SPS, 3),
+                "batch": GLOBAL_BATCH,
+                "value_b1024": round(sps_small, 1),
+                "vs_baseline_b1024": round(sps_small / ROUND1_BASELINE_SPS, 3),
             }
         )
     )

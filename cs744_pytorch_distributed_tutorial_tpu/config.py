@@ -97,6 +97,14 @@ class TrainConfig:
     # optax chain; runs in interpret mode off-TPU.
     fused_optimizer: bool = False
 
+    # Route wide stride-1 3x3 ResNet convs through the Pallas wgrad
+    # kernel (ops/fused_conv.py). Off by default: in-graph measurement
+    # on the v5e showed XLA's batch-minor activation layouts force
+    # relayout copies around the custom call that outweigh the kernel's
+    # isolated win (see benchmarks/ablate.py round-2 notes); the flag
+    # exists for shapes/layouts where the kernel wins and for tests.
+    fast_conv: bool = False
+
     # Input-pipeline prefetch depth: batches staged ahead by a background
     # thread (the DataLoader num_workers/pin_memory analog,
     # master/part1/part1.py:80-93). 0 disables.

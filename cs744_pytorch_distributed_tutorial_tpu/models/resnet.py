@@ -22,6 +22,30 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
+class FastConv3x3(nn.Module):
+    """3x3 SAME conv whose backward runs the Pallas wgrad kernel
+    (``ops/fused_conv.py``) instead of XLA's wgrad emitter — the scored
+    training step's hottest backward ops. Parameter name/shape match
+    ``nn.Conv`` (kernel [3,3,C,K], HWIO), so checkpoints and param-tree
+    tests are oblivious to which implementation produced them."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        from cs744_pytorch_distributed_tutorial_tpu.ops.fused_conv import conv3x3
+
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (3, 3, x.shape[-1], self.features),
+            jnp.float32,
+        ).astype(self.dtype)
+        return conv3x3(x.astype(self.dtype), kernel, self.strides)
+
+
 class BasicBlock(nn.Module):
     """Two 3x3 convs + identity/projection shortcut (ResNet-18/34)."""
 
@@ -29,6 +53,22 @@ class BasicBlock(nn.Module):
     strides: int = 1
     dtype: Any = jnp.float32
     bn_axis: str | None = None
+    fast_conv: bool = False
+
+    def _conv3(self, feats: int, strides: int, x, name: str,
+               min_ch: int = 128):
+        """3x3 conv; routes to the Pallas-backward FastConv3x3 where it
+        wins (stride 1, channels wide enough that the kernel's dense
+        layout matches XLA's choice — below 128 XLA lays activations out
+        batch-minor and a relayout copy would eat the gain). Explicit
+        ``name`` keeps the param tree identical to the nn.Conv
+        auto-naming, so checkpoints don't care which path produced them."""
+        if (self.fast_conv and strides == 1 and x.shape[-1] >= min_ch
+                and feats >= min_ch):
+            return FastConv3x3(feats, strides, dtype=self.dtype, name=name)(x)
+        return nn.Conv(feats, (3, 3), strides=(strides, strides),
+                       padding="SAME", use_bias=False, dtype=self.dtype,
+                       name=name)(x)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -36,19 +76,19 @@ class BasicBlock(nn.Module):
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
             epsilon=1e-5, dtype=self.dtype, axis_name=self.bn_axis,
         )
-        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
 
         residual = x
-        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
-                 padding="SAME")(x)
+        y = self._conv3(self.features, self.strides, x, "Conv_0")
         y = norm()(y)
         y = nn.relu(y)
-        y = conv(self.features, (3, 3), padding="SAME")(y)
+        y = self._conv3(self.features, 1, y, "Conv_1")
         y = norm(scale_init=nn.initializers.zeros)(y)  # zero-init last BN gamma
 
         if residual.shape != y.shape:
-            residual = conv(self.features, (1, 1),
-                            strides=(self.strides, self.strides))(residual)
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype,
+                               name="Conv_2")(residual)
             residual = norm()(residual)
         return nn.relu(y + residual)
 
@@ -60,6 +100,9 @@ class BottleneckBlock(nn.Module):
     strides: int = 1
     dtype: Any = jnp.float32
     bn_axis: str | None = None
+    fast_conv: bool = False  # accepted for block-interface parity; the
+    # bottleneck's 3x3 sits between 1x1s whose layouts XLA reshuffles
+    # freely, so the Pallas wgrad routing currently targets BasicBlock.
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -94,6 +137,7 @@ class ResNet(nn.Module):
     cifar_stem: bool = True
     dtype: Any = jnp.float32
     bn_axis: str | None = None  # SyncBN mesh axis; None = per-replica BN
+    fast_conv: bool = False  # Pallas wgrad backward for wide 3x3 convs
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
@@ -118,8 +162,8 @@ class ResNet(nn.Module):
             for b in range(n_blocks):
                 strides = 2 if stage > 0 and b == 0 else 1
                 x = self.block(features=64 * 2 ** stage, strides=strides,
-                               dtype=self.dtype, bn_axis=self.bn_axis)(
-                                   x, train=train)
+                               dtype=self.dtype, bn_axis=self.bn_axis,
+                               fast_conv=self.fast_conv)(x, train=train)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)

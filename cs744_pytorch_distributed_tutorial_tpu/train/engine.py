@@ -136,6 +136,12 @@ class Trainer:
                 else cfg.imagenet_stem
             )
             model_kw["cifar_stem"] = not use_imagenet_stem
+            if cfg.fast_conv:
+                model_kw["fast_conv"] = True
+        elif cfg.fast_conv:
+            raise ValueError(
+                f"fast_conv routes ResNet 3x3 convs; {cfg.model!r} has none"
+            )
         if cfg.sync_bn:
             if not (
                 cfg.model.startswith(("vgg", "resnet")) or cfg.model == "tiny_cnn"
