@@ -15,8 +15,37 @@ Measured 2026-07-30, one TPU v5e chip, batch 4096 bf16:
   fwd_bwd       123.26 ms   (backward ~2x forward, the standard ratio)
   full          127.22 ms   (optimizer ~4 ms; 32.2k sps at this batch)
   full_no_aug   125.92 ms   (augmentation nearly free after overlap)
-Conclusion: the remaining time is XLA's conv/BN schedule, not framework
-overhead — further gains need fused custom kernels, not orchestration.
+
+Round-2 device-trace breakdown (jax.profiler over the tunnel works; the
+per-op numbers below are device time from the trace, fwd+bwd = 117.9 ms
+at batch 4096 bf16 — host-side probes are unreliable here because the
+tunnel's per-dispatch overhead is 2-10 ms and variable, so time kernels
+either in-graph or from the trace):
+  - backward convs ~78 ms, the top block being stage-1 (4 convs x ~8 ms:
+    wgrad ~5.6 via XLA's EmitAllBatchInSublanes at ~55 TF/s + dgrad ~2.4);
+  - XLA lays stage-1 activations out BATCH-minor ({0,3,2,1}) so its
+    forward convs get full 128-lane tiles from the batch dim — the naive
+    "64 channels half-fill lanes" read was wrong for fwd, right for wgrad;
+  - BatchNorm's full in-step cost is ~19.7 ms (117.9 vs 98.2 norm-free):
+    HBM stat passes + backward reduces, only removable by fusing stats
+    into conv epilogues (i.e. owning the convs);
+  - the Pallas wgrad kernel (ops/fused_conv.py) hits 3.15 ms on stage-1
+    shapes and 1.88 ms on stage-2 in isolation — at/above XLA's isolated
+    emitter — but IN-graph the layout mismatch (custom calls pin dense
+    row-major operands vs XLA's batch-minor choice) inserts 2x ~3.1 ms
+    relayout copies per conv and the end-to-end step got SLOWER
+    (117.9 -> 159.5). Hence cfg.fast_conv defaults off.
+  - xla_tpu_scoped_vmem_limit_kib=65536 (v5e has 128 MiB physical VMEM
+    vs the 16 MiB scoped default) lets XLA fuse deeper: step 125.6 ->
+    117.3 ms; bench.py compiles with it. Fused SGD and the in-graph
+    multi-step scan are each within noise of the default at this batch
+    (the round-1 "scan wedges the tunnel" behavior is gone — the scan
+    runs fine now, it's just not faster than per-step dispatch, whose
+    overhead hides under the 117 ms step).
+Next lever, if pursued: own the stem+stage1(+stage-2 entry) subgraph
+end-to-end in Pallas (fwd conv+BN-stats+ReLU, bwd fused dgrad/wgrad/BN)
+so the custom layout never meets XLA's — the owned region is ~63 ms of
+XLA time with a ~45 ms kernel-side ceiling estimate.
 """
 
 from __future__ import annotations

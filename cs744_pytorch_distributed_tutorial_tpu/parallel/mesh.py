@@ -148,16 +148,60 @@ def host_to_global(tree, sharding: NamedSharding):
     this helper exists to avoid)."""
 
     def put(x):
-        if sharding.is_fully_addressable:
-            return jax.device_put(x, sharding)
+        if isinstance(x, jax.Array) and x.sharding.is_equivalent_to(
+            sharding, x.ndim
+        ):
+            # Orbax-restored (or otherwise already-placed) global arrays
+            # come back with the target sharding; re-placing them would
+            # either be a no-op or — for process-spanning shardings —
+            # crash in np.asarray below. Pass them through.
+            return x
         if hasattr(x, "dtype") and jax.dtypes.issubdtype(
             x.dtype, jax.dtypes.prng_key
         ):
             # Typed PRNG keys can't round-trip through NumPy: place the
             # underlying uint32 data, re-wrap with the same impl.
             impl = jax.random.key_impl(x)
-            placed = put(np.asarray(jax.random.key_data(x)))
+            placed = put(jax.random.key_data(x))
             return jax.random.wrap_key_data(placed, impl=impl)
+        if sharding.is_fully_addressable:
+            return jax.device_put(x, sharding)
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # A global array on a *different* process-spanning sharding:
+            # np.asarray would raise 'spans non-addressable devices'.
+            # Serve the target's local slices from the shards this
+            # process owns (restore flows keep per-process coverage
+            # aligned, e.g. replicated -> sharded on the same mesh).
+            shards = [
+                (
+                    tuple(s_.indices(d) for s_, d in zip(sh.index, x.shape)),
+                    np.asarray(sh.data),
+                )
+                for sh in x.addressable_shards
+            ]
+
+            def from_local(idx):
+                want = tuple(
+                    s_.indices(d) for s_, d in zip(idx, x.shape)
+                )
+                for have, data in shards:
+                    if all(
+                        h[0] <= w[0] and w[1] <= h[1]
+                        for h, w in zip(have, want)
+                    ):
+                        rel = tuple(
+                            slice(w[0] - h[0], w[1] - h[0])
+                            for h, w in zip(have, want)
+                        )
+                        return data[rel]
+                raise ValueError(
+                    f"process owns no data for index {idx} of global array "
+                    f"with shape {x.shape}; cross-process resharding via "
+                    "host_to_global requires local coverage of the target's "
+                    "slices"
+                )
+
+            return jax.make_array_from_callback(x.shape, sharding, from_local)
         arr = np.asarray(x)
         return jax.make_array_from_callback(
             arr.shape, sharding, lambda idx: arr[idx]

@@ -91,7 +91,17 @@ class Checkpointer:
             m = meta_by_path.get(_path_key(path))
             if m is None or tuple(m.shape) == tuple(leaf.shape):
                 return leaf
-            return jax.ShapeDtypeStruct(tuple(m.shape), leaf.dtype)
+            # Explicit host-local sharding: left unset, Orbax restores
+            # with the sharding RECORDED in the checkpoint — which can
+            # name device ids that don't exist on the (different-world)
+            # restoring host, exactly the case this elastic path serves.
+            return jax.ShapeDtypeStruct(
+                tuple(m.shape),
+                leaf.dtype,
+                sharding=jax.sharding.SingleDeviceSharding(
+                    jax.local_devices()[0]
+                ),
+            )
 
         target = jax.tree_util.tree_map_with_path(saved_shaped, template)
         raw = self.manager.restore(
@@ -99,6 +109,18 @@ class Checkpointer:
         )
 
         def adapt(saved, like):
+            if isinstance(saved, jax.Array) and not saved.is_fully_addressable:
+                if saved.shape == like.shape:
+                    # Same-shape leaf already living on a process-spanning
+                    # sharding: device_get would raise; the caller's
+                    # place_state/host_to_global handles any re-placement.
+                    return saved
+                raise ValueError(
+                    "mesh-elastic adaptation of a process-spanning leaf "
+                    f"(shape {saved.shape} -> {like.shape}) is not "
+                    "supported: restore on a single-host mesh first, or "
+                    "match the saved world size"
+                )
             saved = np.asarray(jax.device_get(saved))
             if saved.shape == like.shape:
                 return saved
