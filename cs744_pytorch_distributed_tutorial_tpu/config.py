@@ -80,6 +80,11 @@ class TrainConfig:
     # Label smoothing: target distribution (1-s) one-hot + s/num_classes.
     # 0.0 reproduces the reference's plain CE (master/part1/part1.py:94).
     label_smoothing: float = 0.0
+    # Train-time crop/flip augmentation (the reference's transform_train,
+    # master/part1/part1.py:68-73). False trains on normalize-only inputs
+    # — needed for deterministic cross-framework trajectory comparison
+    # (tests/test_torch_parity.py pins the torch loss curve this way).
+    augment: bool = True
     # Gradient accumulation: split each device's batch shard into this
     # many sequential microbatches (lax.scan) — one microbatch's
     # activations live at a time. BN statistics update per microbatch.

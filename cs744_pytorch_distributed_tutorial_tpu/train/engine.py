@@ -343,7 +343,11 @@ class Trainer:
             # seed-discipline analog, master/part2a/part2a.py:89-90).
             key = jax.random.fold_in(base_key, state.step)
             key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
-            x = augment_train_batch(key, images)
+            x = (
+                augment_train_batch(key, images)
+                if cfg.augment
+                else eval_batch(images)
+            )
             drop_key = jax.random.fold_in(key, 7)
 
             local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)

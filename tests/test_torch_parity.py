@@ -288,3 +288,156 @@ def test_vgg11_param_count_matches_torch_reference_shape():
                                  torch.nn.Linear(512, 10))
     t_params = sum(p.numel() for p in tmodel.parameters())
     assert n_params == t_params
+
+
+def _torch_vgg11():
+    """The reference architecture in torch (built from the published
+    table, as above)."""
+    cfg = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+    layers, c_in = [], 3
+    for entry in cfg:
+        if entry == "M":
+            layers.append(torch.nn.MaxPool2d(2, 2))
+        else:
+            layers += [
+                torch.nn.Conv2d(c_in, entry, 3, padding=1, bias=True),
+                torch.nn.BatchNorm2d(entry),
+                torch.nn.ReLU(inplace=True),
+            ]
+            c_in = entry
+    return torch.nn.Sequential(
+        *layers, torch.nn.Flatten(), torch.nn.Linear(512, 10)
+    )
+
+
+def _copy_flax_vgg_params_to_torch(params, tmodel):
+    """Load the flax init into the torch model: conv kernels HWIO->OIHW,
+    dense [in,out] -> [out,in]; BN scale/bias by order."""
+    convs = [m for m in tmodel if isinstance(m, torch.nn.Conv2d)]
+    bns = [m for m in tmodel if isinstance(m, torch.nn.BatchNorm2d)]
+    linear = [m for m in tmodel if isinstance(m, torch.nn.Linear)][0]
+    with torch.no_grad():
+        for i, conv in enumerate(convs):
+            p = params[f"Conv_{i}"]
+            conv.weight.copy_(
+                torch.from_numpy(
+                    np.asarray(p["kernel"]).transpose(3, 2, 0, 1).copy()
+                )
+            )
+            conv.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+        for i, bn in enumerate(bns):
+            p = params[f"BatchNorm_{i}"]
+            bn.weight.copy_(torch.from_numpy(np.asarray(p["scale"])))
+            bn.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+        d = params["Dense_0"]
+        linear.weight.copy_(
+            torch.from_numpy(np.asarray(d["kernel"]).T.copy())
+        )
+        linear.bias.copy_(torch.from_numpy(np.asarray(d["bias"])))
+
+
+def test_vgg11_loss_curve_matches_torch_trajectory(mesh4):
+    """SURVEY §4's north star: loss-curve parity against the reference's
+    ACTUAL torch trajectory, not just a self-recorded golden trace.
+
+    Same init (flax params copied into torch), same data (deterministic
+    normalized batches, augmentation off on both sides), same math
+    (SGD 0.1/0.9/1e-4 + CE — ``master/part3/part3.py:24-48``'s loop):
+    the two frameworks' per-step losses must track. The comparison runs
+    the engine's single-replica semantics (part1 ==
+    world-size-1 part3: ``DDP(model)`` with one rank is the bare
+    model); the strategy-parity suite (test_sync_parity.py) separately
+    pins part2a/2a_extra/2b/3 gradients equal to this path, closing the
+    chain to every reference part."""
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.data.augment import (
+        CIFAR10_MEAN,
+        CIFAR10_STD,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    steps, batch = 10, 32
+    # The reference's lr=0.1 at this small comparison batch is a chaotic
+    # regime (losses spike past 20 before descending): infinitesimal
+    # framework differences amplify exponentially and no tolerance is
+    # meaningful. The parity claim is about the MATH (same init, data,
+    # update rule), so the comparison runs the same recipe at a stable
+    # lr; the reference's own operating point (batch 256, lr 0.1) is the
+    # on-chip golden run (benchmarks/vgg11_golden.json).
+    lr = 0.02
+    ds = synthetic_cifar10(steps * batch, 8, seed=0)
+
+    # ---- JAX side: the engine on a 1-device mesh (part1 semantics so
+    # BatchNorm sees the same batch on both sides), augmentation off.
+    mesh1 = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    cfg = TrainConfig(
+        model="vgg11", sync="none", num_devices=1, global_batch_size=batch,
+        synthetic_data=True, augment=False, learning_rate=lr,
+    )
+    tr = Trainer(cfg, mesh=mesh1)
+    state = tr.init()
+    key = jax.random.key(cfg.seed)
+    jax_losses = []
+    for s in range(steps):
+        xb, yb = shard_global_batch(
+            mesh1,
+            ds.train_images[s * batch : (s + 1) * batch],
+            ds.train_labels[s * batch : (s + 1) * batch],
+        )
+        state, metrics = tr.train_step(state, xb, yb, key)
+        jax_losses.append(float(metrics["loss"]))
+
+    # ---- torch side: same init, same normalized batches, same recipe.
+    tmodel = _torch_vgg11()
+    variables = tr.model.init(
+        jax.random.key(cfg.seed), jnp.zeros((1, 32, 32, 3)), train=False
+    )
+    _copy_flax_vgg_params_to_torch(variables["params"], tmodel)
+    # the engine's init used the same seed, so state.params == variables'
+    opt = torch.optim.SGD(
+        tmodel.parameters(), lr=cfg.learning_rate,
+        momentum=cfg.momentum, weight_decay=cfg.weight_decay,
+    )
+    criterion = torch.nn.CrossEntropyLoss()
+    mean = np.asarray(CIFAR10_MEAN, np.float32)
+    std = np.asarray(CIFAR10_STD, np.float32)
+    tmodel.train()
+    torch_losses = []
+    for s in range(steps):
+        imgs = ds.train_images[s * batch : (s + 1) * batch]
+        x = (imgs.astype(np.float32) / 255.0 - mean) / std
+        xt = torch.from_numpy(x.transpose(0, 3, 1, 2).copy())
+        yt = torch.from_numpy(
+            ds.train_labels[s * batch : (s + 1) * batch].astype(np.int64)
+        )
+        opt.zero_grad()
+        loss = criterion(tmodel(xt), yt)
+        loss.backward()
+        opt.step()
+        torch_losses.append(float(loss.detach()))
+
+    # Step-0 loss is a pure forward over identical params/data: tight.
+    assert abs(jax_losses[0] - torch_losses[0]) / torch_losses[0] < 1e-3, (
+        jax_losses[0], torch_losses[0],
+    )
+    # The curves must track through the descent phase (curve-shape
+    # tolerance: SURVEY §7 hard part d — bitwise parity is not
+    # meaningful across frameworks). Once the loss memorizes below 0.1,
+    # run-to-run noise (torch's threaded CPU backward is not
+    # deterministic) dominates the relative comparison, so those steps
+    # assert only the shared destination below.
+    compared = 0
+    for j, t in zip(jax_losses, torch_losses):
+        if t >= 0.1:
+            assert abs(j - t) / t < 0.04, (jax_losses, torch_losses)
+            compared += 1
+    assert compared >= 4, (jax_losses, torch_losses)
+    # and both must actually converge to the same tiny-loss regime
+    assert jax_losses[-1] < 0.1 and torch_losses[-1] < 0.1, (
+        jax_losses, torch_losses,
+    )
