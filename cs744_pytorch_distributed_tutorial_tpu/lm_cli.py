@@ -75,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--grad-clip-norm", type=float, default=None)
     p.add_argument("--label-smoothing", type=float, default=0.0)
+    p.add_argument("--dropout-rate", type=float, default=0.0,
+                   help="residual dropout on each block's sublayer "
+                        "outputs; masks are keyed by the step index")
     p.add_argument("--accum-steps", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
@@ -161,6 +164,7 @@ def main(argv: list[str] | None = None) -> int:
         weight_decay=args.weight_decay,
         grad_clip_norm=args.grad_clip_norm,
         label_smoothing=args.label_smoothing,
+        dropout_rate=args.dropout_rate,
         accum_steps=args.accum_steps,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,

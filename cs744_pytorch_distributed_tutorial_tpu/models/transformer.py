@@ -342,11 +342,14 @@ class Block(nn.Module):
     def __call__(
         self,
         x: jnp.ndarray,
+        deterministic: bool = True,
         *,
         mode: str = "train",
         decode_pos: jnp.ndarray | None = None,
-        deterministic: bool = True,
     ) -> jnp.ndarray:
+        # ``deterministic`` is positional (arg index 2 counting self) so
+        # the remat wrapper can declare it static — as a kw-only arg it
+        # would be traced and TracerBoolConversionError on the branch.
         tp = self.tensor_axis is not None and self.tensor_axis_size > 1
         # The MoE path never shards d_ff over the tensor axis (experts
         # compute replicated), so the divisibility constraint applies to
@@ -468,6 +471,14 @@ class TransformerLM(nn.Module):
     # Grouped-query attention: KV head count (None = num_heads). The KV
     # cache shrinks by num_heads/num_kv_heads.
     num_kv_heads: int | None = None
+    # Residual dropout on each block's attention/MLP sublayer outputs
+    # (Block.dropout_rate). Active only when the caller passes
+    # deterministic=False and supplies a 'dropout' rng. Masks must be
+    # IDENTICAL across a tensor-parallel axis (mlp dropout applies to
+    # partial sums before the row-parallel psum), so the rng the trainer
+    # folds must not vary along it — train/lm.py derives it from
+    # (step, data index, seq index) only.
+    dropout_rate: float = 0.0
 
     @nn.compact
     def __call__(
@@ -476,6 +487,7 @@ class TransformerLM(nn.Module):
         *,
         mode: str = "train",
         decode_pos: jnp.ndarray | None = None,
+        deterministic: bool = True,
     ) -> jnp.ndarray:
         b, t_local = tokens.shape
         tok_embed = nn.Embed(
@@ -505,7 +517,9 @@ class TransformerLM(nn.Module):
         # backward pass whose activation memory it could save.
         if self.remat and mode == "train":
             block_cls = nn.remat(
-                Block, policy=resolve_remat_policy(self.remat_policy)
+                Block,
+                policy=resolve_remat_policy(self.remat_policy),
+                static_argnums=(2,),  # deterministic (self=0, x=1)
             )
         else:
             block_cls = Block
@@ -530,13 +544,17 @@ class TransformerLM(nn.Module):
                 rope=self.use_rope,
                 rope_base=self.rope_base,
                 num_kv_heads=self.num_kv_heads,
+                dropout_rate=self.dropout_rate,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults
             # ARE train mode, so pass the decode kwargs only off of it.
-            x = block(x) if mode == "train" else block(
-                x, mode=mode, decode_pos=decode_pos
-            )
+            # ``deterministic`` rides positionally so the remat
+            # static_argnums above keeps it a Python bool.
+            if mode == "train":
+                x = block(x, deterministic)
+            else:
+                x = block(x, mode=mode, decode_pos=decode_pos)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         if self.tie_embeddings:
             logits = tok_embed.attend(x)

@@ -125,6 +125,14 @@ class LMConfig:
     # Incompatible with fused_xent (the kernel computes plain CE).
     label_smoothing: float = 0.0
 
+    # Residual dropout on each block's attention/MLP sublayer outputs —
+    # the round-1 deferred rng migration (docs/roadmap.md). The step
+    # index keys the mask stream: ``train_step(..., step=k)`` draws the
+    # same masks for the same k on every run, different masks per step.
+    # 0.0 reproduces the dropout-free path exactly (golden traces pin
+    # this).
+    dropout_rate: float = 0.0
+
     # Gradient accumulation: split each device's batch shard into
     # ``accum_steps`` microbatches, run fwd/bwd per microbatch under
     # ``lax.scan`` (activations for only ONE microbatch live at a time —
@@ -263,6 +271,7 @@ class LMTrainer:
             tie_embeddings=cfg.tie_embeddings,
             use_rope=cfg.use_rope,
             num_kv_heads=cfg.num_kv_heads,
+            dropout_rate=cfg.dropout_rate,
         )
         if cfg.grad_clip_norm is not None and (
             self.tensor_size > 1 or self.expert_parallel
@@ -388,12 +397,32 @@ class LMTrainer:
                 "kernel computes plain CE"
             )
 
-        def local_step(params, opt_state, tokens, targets):
-            def loss_fn(p, toks, tgts):
+        dropout = self.cfg.dropout_rate
+        seed = self.cfg.seed
+
+        def local_step(params, opt_state, tokens, targets, step):
+            # Dropout rng: keyed by (step, data index, seq index) — NOT
+            # the tensor index: the MLP dropout applies to row-parallel
+            # partial sums before their psum, so tensor shards must draw
+            # IDENTICAL masks for the sum to remain a dropout of the sum.
+            # Data/seq shards hold different tokens and fold their axis
+            # indices for independent masks.
+            drop_base = jax.random.fold_in(jax.random.key(seed), step)
+            drop_base = jax.random.fold_in(
+                drop_base, lax.axis_index(DATA_AXIS)
+            )
+            drop_base = jax.random.fold_in(drop_base, lax.axis_index(SEQ_AXIS))
+
+            def loss_fn(p, toks, tgts, drop_key):
                 # mutable=["losses"] collects each MoE layer's sown
                 # load-balancing aux term (empty when the FFNs are dense).
+                apply_kw = (
+                    dict(rngs={"dropout": drop_key}, deterministic=False)
+                    if dropout > 0.0
+                    else {}
+                )
                 logits, mut = model.apply(
-                    {"params": p}, toks, mutable=["losses"]
+                    {"params": p}, toks, mutable=["losses"], **apply_kw
                 )
                 if fused_xent:
                     from cs744_pytorch_distributed_tutorial_tpu.ops.fused_xent import (
@@ -434,7 +463,7 @@ class LMTrainer:
             # exact global mean.
             if accum == 1:
                 local_loss, grads = jax.value_and_grad(loss_fn)(
-                    params, tokens, targets
+                    params, tokens, targets, drop_base
                 )
             else:
                 # Gradient accumulation: scan over microbatches so only
@@ -442,10 +471,13 @@ class LMTrainer:
                 # gradient SUM accumulates in the carry and averages out.
                 mb_tok = tokens.reshape(accum, -1, tokens.shape[-1])
                 mb_tgt = targets.reshape(accum, -1, targets.shape[-1])
+                mb_keys = jax.random.split(drop_base, accum)
 
                 def body(carry, mb):
                     g_sum, l_sum = carry
-                    l, g = jax.value_and_grad(loss_fn)(params, mb[0], mb[1])
+                    l, g = jax.value_and_grad(loss_fn)(
+                        params, mb[0], mb[1], mb[2]
+                    )
                     return (
                         jax.tree.map(jnp.add, g_sum, g),
                         l_sum + l,
@@ -453,7 +485,9 @@ class LMTrainer:
 
                 zeros = jax.tree.map(jnp.zeros_like, params)
                 (g_sum, l_sum), _ = lax.scan(
-                    body, (zeros, jnp.zeros((), jnp.float32)), (mb_tok, mb_tgt)
+                    body,
+                    (zeros, jnp.zeros((), jnp.float32)),
+                    (mb_tok, mb_tgt, mb_keys),
                 )
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 local_loss = l_sum / accum
@@ -463,16 +497,26 @@ class LMTrainer:
             params = optax.apply_updates(params, updates)
             return params, opt_state, {"loss": loss}
 
-        self.train_step = jax.jit(
+        mapped_step = jax.jit(
             jax.shard_map(
                 local_step,
                 mesh=self.mesh,
-                in_specs=(param_specs, opt_specs, batch_spec, batch_spec),
+                in_specs=(param_specs, opt_specs, batch_spec, batch_spec, P()),
                 out_specs=(param_specs, opt_specs, {"loss": P()}),
                 check_vma=False,
             ),
             donate_argnums=(0, 1),
         )
+
+        def train_step(params, opt_state, tokens, targets, step=0):
+            """``step`` keys the dropout mask stream (ignored at
+            dropout_rate=0, so existing call sites stay valid); ``fit``
+            threads the real step index."""
+            return mapped_step(
+                params, opt_state, tokens, targets, jnp.int32(step)
+            )
+
+        self.train_step = train_step
 
         def local_eval(params, tokens, targets):
             logits = model.apply({"params": params}, tokens)
@@ -618,7 +662,9 @@ class LMTrainer:
                 if arm_now:
                     watchdog.arm()
                 try:
-                    params, opt_state, m = self.train_step(params, opt_state, x, y)
+                    params, opt_state, m = self.train_step(
+                        params, opt_state, x, y, step
+                    )
                     loss = float(m["loss"])
                 finally:
                     if arm_now:
