@@ -138,6 +138,201 @@ def spmd_pipeline(
 
 
 # --------------------------------------------------------------------------
+# 1F1B: hand-scheduled forward+backward pipeline
+# --------------------------------------------------------------------------
+def one_f_one_b_pipeline(
+    stage_fn,
+    post_fn,
+    stage_params,
+    post_params,
+    mb_inputs: jax.Array,
+    mb_targets: jax.Array,
+    *,
+    axis_name: str,
+    num_stages: int,
+    num_microbatches: int,
+):
+    """One-forward-one-backward schedule with the backward written out
+    explicitly (recompute + per-stage VJP) instead of derived by AD of
+    the forward scan.
+
+    Why it exists: the GPipe path (``spmd_pipeline`` + ``jax.grad``)
+    keeps one saved activation per forward tick — ``M + S - 1``
+    microbatch stashes live until the reversed scan consumes them. Here
+    a stage backwards each microbatch as soon as its cotangent returns,
+    so the stash is a ``2S - 1``-slot ring buffer REGARDLESS of M — the
+    memory property 1F1B exists for (large-M runs stop scaling their
+    activation memory with M). Tick cost matches the remat'd GPipe path:
+    three scan phases (fwd-only warmup ``S-1`` waves, mixed ``M`` waves,
+    bwd-only drain ``S-1`` waves) total one forward + one
+    recompute-backward per microbatch per stage, the same
+    ``2(M + S - 1)``-tick span — the lockstep-SPMD 1F1B identity (the
+    schedule reduces idle ticks' *memory*, not the warmup/drain bubble,
+    which for both schedules is ``(S-1)/(M+S-1)`` of ticks per
+    direction).
+
+    Stage asymmetry in one code path: each backward tick differentiates
+
+        objective = where(is_last, post_fn(pp, y, tgt), sum(y * g_in))
+
+    w.r.t. (stage_params, post_params, x). On the last stage that IS the
+    loss VJP (d_post flows); on inner stages ``sum(y * g_in)`` has
+    ``d/dy = g_in``, i.e. plain cotangent chaining (and ``d_post`` is
+    exactly zero). ``post_fn(pp, y, tgt) -> scalar`` is the per-
+    microbatch tail (final norm + head + loss) applied only at the last
+    stage.
+
+    Returns ``(loss, d_stage_params, d_post_params, d_mb_inputs)`` —
+    loss and the d_post/d_mb trees psum-replicated over the pipe axis,
+    all averaged over microbatches.
+    """
+    s, m = num_stages, num_microbatches
+    if mb_inputs.shape[0] != m:
+        raise ValueError(
+            f"mb_inputs leading dim {mb_inputs.shape[0]} != num_microbatches {m}"
+        )
+    stage = lax.axis_index(axis_name)
+    fwd = [(i, i + 1) for i in range(s - 1)]
+    rev = [(i + 1, i) for i in range(s - 1)]
+    n_slots = 2 * s - 1  # worst case in flight on stage 0: 2(S-1)+1
+
+    mb_shape = mb_inputs.shape[1:]
+    is_last = stage == s - 1
+
+    def fwd_half(fwd_carry, stash, t):
+        """Wave-t forward: stage d forwards microbatch t - d."""
+        fwd_idx = t - stage
+        active = jnp.logical_and(fwd_idx >= 0, fwd_idx < m)
+        inject = lax.dynamic_index_in_dim(
+            mb_inputs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(stage == 0, inject, fwd_carry)
+        y = stage_fn(stage_params, x_in)
+        slot = jnp.clip(fwd_idx, 0, m - 1) % n_slots
+        prev = lax.dynamic_index_in_dim(stash, slot, axis=0, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(active, x_in, prev), slot, axis=0
+        )
+        if s > 1:
+            y = lax.ppermute(y, axis_name, perm=fwd)
+        return y, stash
+
+    def bwd_half(bwd_carry, stash, acc, t):
+        """Wave-t backward: stage d backwards microbatch t - 2(S-1) + d
+        (the cotangent reached it after S-1-d reverse hops)."""
+        d_stage_acc, d_post_acc, d_in_acc, loss_acc = acc
+        bwd_idx = t - 2 * (s - 1) + stage
+        active = jnp.logical_and(bwd_idx >= 0, bwd_idx < m)
+        idxc = jnp.clip(bwd_idx, 0, m - 1)
+        x_saved = lax.dynamic_index_in_dim(
+            stash, idxc % n_slots, axis=0, keepdims=False
+        )
+        tgt = lax.dynamic_index_in_dim(mb_targets, idxc, axis=0, keepdims=False)
+        g_in = bwd_carry
+
+        def objective(sp, pp, x):
+            y = stage_fn(sp, x)
+            per_mb = post_fn(pp, y, tgt)
+            return jnp.where(is_last, per_mb, (y * g_in).sum())
+
+        obj, (d_sp, d_pp, dx) = jax.value_and_grad(
+            objective, argnums=(0, 1, 2)
+        )(stage_params, post_params, x_saved)
+
+        keep = lambda new, old: jax.tree.map(
+            lambda n, o: o + jnp.where(active, n, jnp.zeros_like(n)), new, old
+        )
+        d_stage_acc = keep(d_sp, d_stage_acc)
+        d_post_acc = keep(d_pp, d_post_acc)
+        loss_acc = loss_acc + jnp.where(
+            jnp.logical_and(is_last, active), obj, 0.0
+        )
+        rec = jnp.logical_and(stage == 0, active)
+        prev = lax.dynamic_index_in_dim(d_in_acc, idxc, axis=0, keepdims=False)
+        d_in_acc = lax.dynamic_update_index_in_dim(
+            d_in_acc, jnp.where(rec, dx, prev), idxc, axis=0
+        )
+        if s > 1:
+            bwd_carry = lax.ppermute(dx, axis_name, perm=rev)
+        else:
+            bwd_carry = dx
+        return bwd_carry, stash, (d_stage_acc, d_post_acc, d_in_acc, loss_acc)
+
+    zero_like = lambda tree: jax.tree.map(
+        lambda x: jnp.zeros(x.shape, x.dtype), tree
+    )
+    carry0 = (
+        jnp.zeros(mb_shape, mb_inputs.dtype),  # fwd activation in flight
+        jnp.zeros(mb_shape, mb_inputs.dtype),  # bwd cotangent in flight
+        jnp.zeros((n_slots,) + mb_shape, mb_inputs.dtype),  # input stash
+        (
+            zero_like(stage_params),
+            zero_like(post_params),
+            jnp.zeros((m,) + mb_shape, mb_inputs.dtype),
+            jnp.zeros((), jnp.float32),
+        ),
+    )
+
+    # Three phases so idle waves don't pay for masked compute: the
+    # warmup waves have no backward work anywhere, the drain waves no
+    # forward work anywhere (uniform across devices, so the split is
+    # static, not data-dependent control flow).
+    def warmup(carry, t):
+        f, b, stash, acc = carry
+        f, stash = fwd_half(f, stash, t)
+        return (f, b, stash, acc), None
+
+    def mixed(carry, t):
+        f, b, stash, acc = carry
+        f, stash = fwd_half(f, stash, t)
+        b, stash, acc = bwd_half(b, stash, acc, t)
+        return (f, b, stash, acc), None
+
+    def drain(carry, t):
+        f, b, stash, acc = carry
+        b, stash, acc = bwd_half(b, stash, acc, t)
+        return (f, b, stash, acc), None
+
+    carry = carry0
+    if s > 1:
+        carry, _ = lax.scan(warmup, carry, jnp.arange(0, s - 1))
+    carry, _ = lax.scan(mixed, carry, jnp.arange(s - 1, m + s - 1))
+    if s > 1:
+        carry, _ = lax.scan(
+            drain, carry, jnp.arange(m + s - 1, m + 2 * (s - 1))
+        )
+    _, _, _, (d_stage, d_post, d_in, loss) = carry
+
+    # Average over microbatches; replicate the single-stage-owned pieces
+    # (loss lives on the last stage, d_post likewise, d_mb_inputs on
+    # stage 0) so downstream code sees pipe-replicated values.
+    scale = 1.0 / m
+    d_stage = jax.tree.map(lambda g: g * scale, d_stage)
+    d_post = jax.tree.map(
+        lambda g: lax.psum(g * scale, axis_name), d_post
+    )
+    d_in = lax.psum(d_in * scale, axis_name)
+    loss = lax.psum(loss * scale, axis_name)
+    return loss, d_stage, d_post, d_in
+
+
+def one_f_one_b_stats(num_stages: int, num_microbatches: int) -> dict:
+    """Static schedule accounting for tests/docs: waves, stash slots, and
+    the GPipe-path equivalents (AD of ``spmd_pipeline``)."""
+    s, m = num_stages, num_microbatches
+    return {
+        # each mixed wave costs one stage forward + one recompute-backward
+        "f1b_waves": (s - 1) + m + (s - 1),
+        "f1b_stash_slots": 2 * s - 1,
+        # forward scan + AD-reversed scan, one stage-compute each
+        "gpipe_ticks": 2 * (m + s - 1),
+        # the reversed scan consumes one saved carry per forward tick
+        "gpipe_stash_slots": m + s - 1,
+        "bubble_fraction": (s - 1) / (m + s - 1),
+    }
+
+
+# --------------------------------------------------------------------------
 # A pure-pytree transformer stack to pipeline
 # --------------------------------------------------------------------------
 def _layer_norm(x, scale, bias, eps=1e-6):
@@ -250,6 +445,11 @@ class PipelineLMConfig:
     data_parallel: int = 1
     pipeline_parallel: int = 2
     num_microbatches: int = 2
+    # "gpipe": forward scan + AD-derived reverse pipeline (activation
+    # stash grows with num_microbatches). "1f1b": hand-scheduled
+    # one-forward-one-backward (one_f_one_b_pipeline) — same tick span,
+    # fixed 2S-1-slot stash, the large-M memory lever.
+    schedule: str = "gpipe"
     # Recompute block activations in backward (jax.checkpoint) — the GPipe
     # memory lever: without it every microbatch's per-layer activations
     # stay live until its backward tick.
@@ -305,6 +505,10 @@ class PipelineLMTrainer:
             )
         if cfg.seq_len > cfg.max_seq_len:
             raise ValueError(f"seq_len {cfg.seq_len} > max_seq_len {cfg.max_seq_len}")
+        if cfg.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown schedule {cfg.schedule!r}; choose 'gpipe' or '1f1b'"
+            )
         self.param_specs = {
             "embed": P(), "pos": P(),
             "blocks": {k: P(PIPE_AXIS) for k in BLOCK_PARAM_NAMES},
@@ -397,7 +601,14 @@ class PipelineLMTrainer:
                 g = lax.pmean(g, PIPE_AXIS)
             return g
 
-        def local_step(params, opt_state, tokens, targets):
+        def stage_fn(sp, h):
+            return stack_apply(
+                sp, h, num_heads, remat=cfg.remat,
+                impl=cfg.attention_impl, interpret=interpret,
+                remat_policy=cfg.remat_policy,
+            )
+
+        def local_step_gpipe(params, opt_state, tokens, targets):
             def loss_fn(p):
                 logits = forward(p, tokens)
                 return optax.softmax_cross_entropy_with_integer_labels(
@@ -405,6 +616,47 @@ class PipelineLMTrainer:
                 ).mean()
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
+            return loss, grads, opt_state
+
+        def local_step_1f1b(params, opt_state, tokens, targets):
+            b, t = tokens.shape
+
+            def embed_fn(ep):
+                x = ep["embed"][tokens] + ep["pos"][:t]
+                return x.reshape(m, b // m, t, cfg.d_model)
+
+            def post_fn(pp, y, tgt):
+                z = _layer_norm(y, pp["ln_f_scale"], pp["ln_f_bias"])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    z @ pp["head"], tgt
+                ).mean()
+
+            embed_params = {"embed": params["embed"], "pos": params["pos"]}
+            post_params = {
+                "ln_f_scale": params["ln_f_scale"],
+                "ln_f_bias": params["ln_f_bias"],
+                "head": params["head"],
+            }
+            mb, embed_vjp = jax.vjp(embed_fn, embed_params)
+            mb_tgt = targets.reshape(m, b // m, t)
+            loss, d_blocks, d_post, d_mb = one_f_one_b_pipeline(
+                stage_fn, post_fn, params["blocks"], post_params,
+                mb, mb_tgt,
+                axis_name=PIPE_AXIS, num_stages=s, num_microbatches=m,
+            )
+            (d_embed,) = embed_vjp(d_mb)
+            grads = {
+                "embed": d_embed["embed"], "pos": d_embed["pos"],
+                "blocks": d_blocks, **d_post,
+            }
+            return loss, grads, opt_state
+
+        inner = (
+            local_step_1f1b if cfg.schedule == "1f1b" else local_step_gpipe
+        )
+
+        def local_step(params, opt_state, tokens, targets):
+            loss, grads, opt_state = inner(params, opt_state, tokens, targets)
             grads = jax.tree.map(sync_grad, grads, param_specs)
             loss = lax.pmean(loss, DATA_AXIS)
             updates, opt_state = tx.update(grads, opt_state, params)
