@@ -32,6 +32,8 @@ def make_beam_searcher(
     eos_id: int | None = None,
     pad_id: int = 0,
     length_penalty: float = 0.0,
+    mesh: Any = None,
+    param_specs: Any = None,
 ):
     """Build a jitted ``search(params, prompt) -> (tokens, scores)``.
 
@@ -40,13 +42,16 @@ def make_beam_searcher(
     raw log-prob, higher values favor longer sequences). ``scores`` is
     the selected beam's raw accumulated log-prob. Same model contract as
     ``make_generator`` (``seq_axis=None``; params from any training mesh
-    drop in).
+    drop in) — including its tensor-parallel path: pass ``mesh`` +
+    ``param_specs`` with an ``LMTrainer.tp_decode_model()`` model and the
+    whole search runs inside shard_map on tensor-sharded params (the
+    replicated logits make every top-k decision identical per device).
     """
     from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
         check_decode_model,
     )
 
-    check_decode_model(model, "beam search")
+    check_decode_model(model, "beam search", allow_tensor=mesh is not None)
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
     if max_new_tokens < 1:
@@ -149,4 +154,12 @@ def make_beam_searcher(
         best_score = jnp.take_along_axis(scores, best[:, None], axis=1).squeeze(1)
         return best_seq, best_score
 
-    return jax.jit(search)
+    if mesh is None:
+        return jax.jit(search)
+    from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
+        _shard_map_decode,
+    )
+
+    return _shard_map_decode(
+        search, model, mesh, param_specs, n_out=2, takes_key=False
+    )

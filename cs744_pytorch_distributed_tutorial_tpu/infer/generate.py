@@ -32,19 +32,28 @@ from jax import lax
 _NEG = -1e30  # additive mask: exp() underflows to exactly 0.0, no NaNs
 
 
-def check_decode_model(model: Any, what: str) -> None:
-    """Decoding runs outside shard_map: the model must have no sequence
-    or tensor mesh axes (scale over batch comes from jit's sharding).
-    Shared by the sampling generator and beam search."""
+def check_decode_model(model: Any, what: str, allow_tensor: bool = False) -> None:
+    """The KV cache holds the full sequence, so the model must have no
+    sequence axis. A tensor axis is allowed only on the shard_map'ped
+    path (``mesh=`` passed to the builders) — each device then caches its
+    local heads and the per-sublayer psums keep the residual stream (and
+    hence the logits) replicated. Shared by the sampling generator and
+    beam search."""
     if getattr(model, "seq_axis", None) is not None and model.seq_axis_size > 1:
         raise ValueError(
             f"{what} needs a model with seq_axis=None; construct a decode "
             "copy of the model (same dims) — trained params drop in directly"
         )
-    if getattr(model, "tensor_axis", None) is not None and model.tensor_axis_size > 1:
+    tp = (
+        getattr(model, "tensor_axis", None) is not None
+        and model.tensor_axis_size > 1
+    )
+    if tp and not allow_tensor:
         raise ValueError(
-            f"{what} does not run under tensor parallelism; construct a "
-            "decode copy with tensor_axis=None from gathered full params"
+            f"{what} with a tensor-parallel model needs the shard_map path: "
+            "pass mesh= and param_specs= (see LMTrainer.tp_decode_model), or "
+            "construct a decode copy with tensor_axis=None from gathered "
+            "full params"
         )
 
 
@@ -100,21 +109,34 @@ def make_generator(
     top_p: float | None = None,
     eos_id: int | None = None,
     pad_id: int = 0,
+    mesh: Any = None,
+    param_specs: Any = None,
 ):
     """Build a jitted ``generate(params, prompt, key) -> [B, max_new_tokens]``.
 
-    ``model`` is a ``TransformerLM`` configured for single-sequence
-    execution (``seq_axis=None``, ``tensor_axis=None``) — generation runs
-    outside ``shard_map``; scale over batch comes from jit's data
-    sharding. Parameters from a sequence-parallel training run drop in
-    directly (attention has no parameters, so the trees are identical).
+    Default path: ``model`` is a ``TransformerLM`` configured for
+    single-sequence execution (``seq_axis=None``, ``tensor_axis=None``) —
+    generation runs outside ``shard_map``; scale over batch comes from
+    jit's data sharding. Parameters from a sequence-parallel training run
+    drop in directly (attention has no parameters, so the trees are
+    identical).
+
+    Tensor-parallel path: pass ``mesh`` (containing the model's
+    ``tensor_axis``) and ``param_specs`` (the trainer's partition specs)
+    with a model built by ``LMTrainer.tp_decode_model()``. The whole
+    sampling loop then runs INSIDE ``shard_map``: each device projects
+    and caches only its ``num_heads/T`` local heads (the KV cache is
+    tensor-sharded by construction), the per-sublayer psums keep the
+    residual stream — and therefore the logits and every sampling
+    decision — replicated across the axis. No full-parameter gather
+    anywhere.
 
     Once a row emits ``eos_id`` it is done: later positions hold
     ``pad_id`` and its cache stops mattering. The loop still runs
     ``max_new_tokens`` steps (static shapes); callers needing the speedup
     of a dynamic stop should shrink ``max_new_tokens`` instead.
     """
-    check_decode_model(model, "generation")
+    check_decode_model(model, "generation", allow_tensor=mesh is not None)
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
 
@@ -165,4 +187,50 @@ def make_generator(
         _, tokens = lax.scan(body, carry, jax.random.split(key, max_new_tokens))
         return tokens.T  # [max_new_tokens, B] -> [B, max_new_tokens]
 
-    return jax.jit(generate)
+    if mesh is None:
+        return jax.jit(generate)
+    return _shard_map_decode(
+        generate, model, mesh, param_specs, n_out=1, takes_key=True
+    )
+
+
+def _shard_map_decode(
+    fn,
+    model: Any,
+    mesh: Any,
+    param_specs: Any,
+    n_out: int,
+    takes_key: bool,
+):
+    """Wrap a decode loop in shard_map over the tensor (and optional
+    data) mesh axes: params ride their training partition specs, token
+    grids shard over the data axis when the mesh has one and replicate
+    over tensor. ``check_vma=False`` for the same reason as the training
+    steps — the Megatron f/g boundaries use axis collectives directly."""
+    from jax.sharding import PartitionSpec
+
+    if param_specs is None:
+        raise ValueError("the shard_map decode path needs param_specs")
+    if model.tensor_axis is None or model.tensor_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} does not carry the model's tensor "
+            f"axis {model.tensor_axis!r}"
+        )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import DATA_AXIS
+
+    tok_spec = (
+        PartitionSpec(DATA_AXIS) if DATA_AXIS in mesh.shape else PartitionSpec()
+    )
+    in_specs = (param_specs, tok_spec) + (
+        (PartitionSpec(),) if takes_key else ()
+    )
+    out_specs = tuple([tok_spec] * n_out) if n_out > 1 else tok_spec
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=False,
+        )
+    )

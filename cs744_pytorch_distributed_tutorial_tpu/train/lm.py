@@ -342,6 +342,48 @@ class LMTrainer:
             attention_impl="dense", flash_interpret=None, remat=False
         )
 
+    def gather_for_decode(self, params):
+        """Materialize tensor-/expert-sharded params as full host arrays
+        (one all-gather + fetch) for the non-shard_map decode path
+        (``decode_model``). Host-side on purpose: the training mesh's
+        axes are Explicit (sharding-in-types), and arrays carried on
+        that mesh cannot mix with the decode program's mesh-free
+        intermediates — while plain host arrays re-place under the
+        decode jit's own defaults. The tensor-parallel path
+        (``tp_decode_model``) needs none of this."""
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree.map(
+            lambda x: jax.device_get(jax.device_put(x, rep)), params
+        )
+
+    def tp_decode_model(self) -> TransformerLM:
+        """Tensor-parallel decode clone: no sequence axis (the KV cache
+        holds the full sequence), tensor axis KEPT — each device caches
+        its local heads and generation runs inside shard_map on the
+        trainer's sharded params, no full gather
+        (``infer/generate.py``'s ``mesh=`` path)::
+
+            gen = make_generator(trainer.tp_decode_model(),
+                                 max_new_tokens=32, temperature=0.0,
+                                 mesh=trainer.mesh,
+                                 param_specs=trainer.param_specs)
+            out = gen(params, prompt, jax.random.key(0))
+        """
+        if self.expert_parallel:
+            raise ValueError(
+                "tp_decode_model does not support expert parallelism; "
+                "decode EP models from gathered params (decode_model)"
+            )
+        return self.model.clone(
+            seq_axis=None,
+            seq_axis_size=1,
+            attention_impl="dense",
+            flash_interpret=None,
+            remat=False,
+        )
+
     def _local_batch_shape(self) -> tuple[int, int]:
         return (
             self.cfg.global_batch_size // self.data_size,
