@@ -409,7 +409,14 @@ def ulysses_attention(
 
     GQA: k/v may arrive at kv width (Hkv dividing H). When Hkv is also
     divisible by the axis, the K/V all_to_alls run at kv width (the
-    H/Hkv ICI saving) and heads widen after; otherwise they widen first.
+    H/Hkv ICI saving) and heads widen after. When it is NOT divisible
+    (ragged MQA/GQA — exactly the configs that need the saving most),
+    the grouped exchange routes each device the kv heads ITS head group
+    actually consumes: kv heads are gathered into per-device-aligned
+    groups (``grouped_kv_plan``) before the all_to_all, so the exchange
+    runs at ``ulysses_kv_exchange_width`` heads per device instead of
+    the full ``H/axis`` of the widen-first fallback. Widen-first remains
+    only when the grouped width wouldn't beat it.
     """
     if inner not in ("dense", "flash"):
         raise ValueError(f"unknown inner attention {inner!r}")
@@ -446,11 +453,64 @@ def ulysses_attention(
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
-    if rep > 1 and k.shape[2] % axis_size == 0:
+    hkv = k.shape[2]
+    if rep > 1 and hkv % axis_size == 0:
         # kv-width collectives: split kv heads over the axis, widen after.
         kg, vg = widen(seq_to_heads(k)), widen(seq_to_heads(v))
+    elif rep > 1 and ulysses_kv_exchange_width(h, hkv, axis_size) < h // axis_size:
+        # Ragged Hkv: grouped exchange at (near-)kv width. Each device's
+        # q head group [i*H/n, (i+1)*H/n) consumes a SMALL set of kv
+        # heads; gather those into per-device slots pre-exchange so the
+        # tiled all_to_all hands every device exactly its set, then map
+        # each local q head onto its received slot.
+        idx, local_map, per_dev = grouped_kv_plan(h, hkv, axis_size)
+        sel = jnp.asarray(idx)
+        kg_, vg_ = (
+            seq_to_heads(x[:, :, sel, :]) for x in (k, v)
+        )  # [B, T, per_dev, D]
+        me = lax.axis_index(axis_name)
+        lmap = jnp.asarray(local_map)[me]  # [H/n] -> received slot
+        kg = jnp.take(kg_, lmap, axis=2)
+        vg = jnp.take(vg_, lmap, axis=2)
     else:
         kg, vg = seq_to_heads(widen(k)), seq_to_heads(widen(v))
     qg = seq_to_heads(q)
     out = local_attention(qg, kg, vg)  # full seq, head group
     return heads_to_seq(out)
+
+
+def grouped_kv_plan(h: int, hkv: int, n: int):
+    """Per-device kv routing for ragged GQA (``hkv % n != 0``).
+
+    Returns ``(idx, local_map, per_dev)``: ``idx`` ([n * per_dev]) lists
+    the kv head to place in each pre-exchange slot (device i's slots are
+    ``idx[i*per_dev:(i+1)*per_dev]`` — the distinct kv heads its q group
+    needs, right-padded by repetition); ``local_map`` ([n, h/n]) maps
+    each device's local q head to its received slot. Pure host-side
+    numpy — the plan is static per (h, hkv, n).
+    """
+    import numpy as np
+
+    rep = h // hkv
+    groups = []
+    for i in range(n):
+        lo, hi = i * h // n, (i + 1) * h // n
+        heads = sorted({qh // rep for qh in range(lo, hi)})
+        groups.append(heads)
+    per_dev = max(len(g) for g in groups)
+    idx, local = [], []
+    for i, g in enumerate(groups):
+        g_pad = g + [g[-1]] * (per_dev - len(g))
+        idx.extend(g_pad)
+        lo = i * h // n
+        local.append([g_pad.index((lo + ql) // rep) for ql in range(h // n)])
+    return np.asarray(idx, np.int32), np.asarray(local, np.int32), per_dev
+
+
+def ulysses_kv_exchange_width(h: int, hkv: int, n: int) -> int:
+    """Heads per device the K/V all_to_all moves under the grouped plan —
+    the collective-bytes accounting the GQA tests assert on (widen-first
+    moves ``h // n``; divisible kv-width moves ``hkv // n``)."""
+    if hkv % n == 0:
+        return hkv // n
+    return grouped_kv_plan(h, hkv, n)[2]
