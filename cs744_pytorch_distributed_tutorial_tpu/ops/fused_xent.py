@@ -8,9 +8,12 @@ vocab tiles stream through VMEM with the online (max, sumexp) update,
 and the label logit is picked up by the tile that contains it. Nothing
 of [N, V] shape is ever written.
 
-Differentiation follows the repo's kernel-forward/XLA-backward split
-(``ops/flash_attention.py``): the backward re-derives
-``(softmax - onehot) * g`` through the canonical dense formulation.
+Differentiation is one-pass on BOTH sides (round 2 — previously the
+backward re-derived through the dense log-softmax, resurrecting the
+[N, V] buffer the kernel exists to avoid): the forward additionally
+emits the per-row logsumexp (an [N] residual), and the backward is a
+stateless tile kernel ``(exp(logit - lse) - onehot) * g`` — one read of
+the logits, one write of the cotangent, nothing else of [N, V] shape.
 
 ``interpret=True`` runs the same kernel on any backend for tests.
 Reference CE semantics (torch ``nn.CrossEntropyLoss``,
@@ -42,7 +45,9 @@ except Exception:  # pragma: no cover
 _NEG = -1e30
 
 
-def _kernel(num_v_blocks, logits_ref, labels_ref, loss_ref, m_ref, s_ref, p_ref):
+def _kernel(
+    num_v_blocks, logits_ref, labels_ref, loss_ref, lse_ref, m_ref, s_ref, p_ref
+):
     vi = pl.program_id(1)
     bn, bv = logits_ref.shape
 
@@ -66,7 +71,23 @@ def _kernel(num_v_blocks, logits_ref, labels_ref, loss_ref, m_ref, s_ref, p_ref)
 
     @pl.when(vi == num_v_blocks - 1)
     def _finish():
-        loss_ref[...] = m_ref[...] + jnp.log(s_ref[...]) - p_ref[...]
+        lse = m_ref[...] + jnp.log(s_ref[...])
+        lse_ref[...] = lse
+        loss_ref[...] = lse - p_ref[...]
+
+
+def _bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, d_ref):
+    """One tile of ``d = (softmax - onehot) * g``: softmax comes from the
+    saved row logsumexp, so the tile is read once and written once —
+    no cross-tile state at all."""
+    vi = pl.program_id(1)
+    bn, bv = logits_ref.shape
+    tile = logits_ref[...].astype(jnp.float32)
+    labels = labels_ref[...]
+    cols = vi * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
+    soft = jnp.exp(tile - lse_ref[...])
+    d = (soft - jnp.where(cols == labels, 1.0, 0.0)) * g_ref[...]
+    d_ref[...] = d.astype(d_ref.dtype)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -88,13 +109,17 @@ def fused_cross_entropy(
     to tile multiples with ``-1e30`` columns (zero softmax mass) and
     dummy rows, both sliced away.
     """
-    return _forward(logits, labels, block_n, block_v, interpret)
+    return _forward(logits, labels, block_n, block_v, interpret)[0]
+
+
+def _blocking(n, v, block_n, block_v):
+    bn, bv = min(block_n, _round_up(n, 8)), min(block_v, _round_up(v, 128))
+    return bn, bv, _round_up(n, bn), _round_up(v, bv)
 
 
 def _forward(logits, labels, block_n, block_v, interpret):
     n, v = logits.shape
-    bn, bv = min(block_n, _round_up(n, 8)), min(block_v, _round_up(v, 128))
-    n_pad, v_pad = _round_up(n, bn), _round_up(v, bv)
+    bn, bv, n_pad, v_pad = _blocking(n, v, block_n, block_v)
     if (n_pad, v_pad) != (n, v):
         logits = jnp.pad(
             logits, ((0, n_pad - n), (0, v_pad - v)), constant_values=_NEG
@@ -109,19 +134,25 @@ def _forward(logits, labels, block_n, block_v, interpret):
         if (_VMEM is not None and not interpret)
         else [pl.ANY((bn, 1), jnp.float32)] * 3
     )
-    loss = pl.pallas_call(
+    loss, lse = pl.pallas_call(
         partial(_kernel, num_v_blocks),
-        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
         grid=(n_pad // bn, num_v_blocks),
         in_specs=[
             pl.BlockSpec((bn, bv), lambda ni, vi: (ni, vi), **spec_kw),
             pl.BlockSpec((bn, 1), lambda ni, vi: (ni, 0), **spec_kw),
         ],
-        out_specs=pl.BlockSpec((bn, 1), lambda ni, vi: (ni, 0), **spec_kw),
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda ni, vi: (ni, 0), **spec_kw),
+            pl.BlockSpec((bn, 1), lambda ni, vi: (ni, 0), **spec_kw),
+        ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(logits, labels2)
-    return loss[:n, 0]
+    return loss[:n, 0], lse[:n, 0]
 
 
 def _dense_reference(logits, labels):
@@ -132,13 +163,38 @@ def _dense_reference(logits, labels):
 
 
 def _fwd(logits, labels, block_n, block_v, interpret):
-    return _forward(logits, labels, block_n, block_v, interpret), (logits, labels)
+    loss, lse = _forward(logits, labels, block_n, block_v, interpret)
+    return loss, (logits, labels, lse)
 
 
 def _bwd(block_n, block_v, interpret, residuals, g):
-    logits, labels = residuals
-    _, vjp = jax.vjp(lambda l: _dense_reference(l, labels), logits)
-    return (*vjp(g), None)
+    logits, labels, lse = residuals
+    n, v = logits.shape
+    bn, bv, n_pad, v_pad = _blocking(n, v, block_n, block_v)
+    if (n_pad, v_pad) != (n, v):
+        logits = jnp.pad(
+            logits, ((0, n_pad - n), (0, v_pad - v)), constant_values=_NEG
+        )
+        labels = jnp.pad(labels, (0, n_pad - n))
+        lse = jnp.pad(lse, (0, n_pad - n))
+        g = jnp.pad(g, (0, n_pad - n))
+    labels2 = labels.astype(jnp.int32)[:, None]
+    lse2 = lse.astype(jnp.float32)[:, None]
+    g2 = g.astype(jnp.float32)[:, None]
+    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    col = pl.BlockSpec((bn, 1), lambda ni, vi: (ni, 0), **spec_kw)
+    d = pl.pallas_call(
+        _bwd_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_pad, v_pad), logits.dtype),
+        grid=(n_pad // bn, v_pad // bv),
+        in_specs=[
+            pl.BlockSpec((bn, bv), lambda ni, vi: (ni, vi), **spec_kw),
+            col, col, col,
+        ],
+        out_specs=pl.BlockSpec((bn, bv), lambda ni, vi: (ni, vi), **spec_kw),
+        interpret=interpret,
+    )(logits, labels2, lse2, g2)
+    return (d[:n, :v], None)
 
 
 fused_cross_entropy.defvjp(_fwd, _bwd)

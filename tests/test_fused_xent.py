@@ -87,3 +87,31 @@ def test_lm_trainer_fused_xent_matches_dense():
         _, _, m = tr.train_step(p, o, x, y)
         losses[fused] = float(m["loss"])
     assert losses[True] == pytest.approx(losses[False], rel=1e-5)
+
+
+def test_one_pass_backward_ragged_and_bf16():
+    """The round-2 one-pass backward (tile kernel from the saved row
+    logsumexp): padded/ragged shapes and bf16 logits must match optax's
+    gradient — nothing of [N, V] shape besides the cotangent itself."""
+    import optax
+
+    rng = np.random.default_rng(4)
+    for n, v, dtype in [(13, 77, jnp.float32), (32, 200, jnp.bfloat16)]:
+        logits = jnp.asarray(rng.standard_normal((n, v)), dtype)
+        labels = jnp.asarray(rng.integers(0, v, n), jnp.int32)
+
+        g_ours = jax.grad(
+            lambda l: fused_cross_entropy(
+                l, labels, 8, 128, True
+            ).sum()
+        )(logits)
+        g_ref = jax.grad(
+            lambda l: optax.softmax_cross_entropy_with_integer_labels(
+                l.astype(jnp.float32), labels
+            ).sum()
+        )(logits.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(g_ours, np.float32), np.asarray(g_ref),
+            rtol=2e-2 if dtype == jnp.bfloat16 else 1e-5,
+            atol=2e-2 if dtype == jnp.bfloat16 else 1e-6,
+        )
