@@ -56,15 +56,18 @@ class BasicBlock(nn.Module):
     fast_conv: bool = False
 
     def _conv3(self, feats: int, strides: int, x, name: str,
-               min_ch: int = 128):
+               min_ch: int = 128, max_ch: int = 256):
         """3x3 conv; routes to the Pallas-backward FastConv3x3 where it
         wins (stride 1, channels wide enough that the kernel's dense
         layout matches XLA's choice — below 128 XLA lays activations out
-        batch-minor and a relayout copy would eat the gain). Explicit
-        ``name`` keeps the param tree identical to the nn.Conv
+        batch-minor and a relayout copy eats the gain — and narrow
+        enough that the k-tiled accumulator still streams well; the
+        512-channel 4x4 stage measured 3x slower than XLA's emitter).
+        Explicit ``name`` keeps the param tree identical to the nn.Conv
         auto-naming, so checkpoints don't care which path produced them."""
-        if (self.fast_conv and strides == 1 and x.shape[-1] >= min_ch
-                and feats >= min_ch):
+        if (self.fast_conv and strides == 1
+                and min_ch <= x.shape[-1] <= max_ch
+                and min_ch <= feats <= max_ch):
             return FastConv3x3(feats, strides, dtype=self.dtype, name=name)(x)
         return nn.Conv(feats, (3, 3), strides=(strides, strides),
                        padding="SAME", use_bias=False, dtype=self.dtype,

@@ -47,3 +47,78 @@ def annotate(name: str):
 def step_annotation(name: str, step: int):
     """Step marker used by TensorBoard's per-step analysis."""
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def device_op_breakdown(
+    fn,
+    *args,
+    iters: int = 3,
+    top: int = 20,
+    trace_dir: str | None = None,
+):
+    """Run ``fn(*args)`` ``iters`` times under a profiler trace and return
+    per-op DEVICE time — the instrument that found the round-2 bench
+    bottlenecks (``benchmarks/ablate.py``).
+
+    Why it exists: on this environment's tunneled TPU backend, host-side
+    timers measure per-dispatch overhead (2-10 ms, variable), so
+    microbenchmarks of sub-10 ms ops are noise. The device trace is
+    ground truth. Works on CPU traces too (tests).
+
+    Returns ``(total_ms, [(ms_per_iter, op_name), ...])`` — device-lane
+    durations aggregated by op name, averaged over ``iters``, sorted
+    descending. Completion is fenced by fetching a concrete scalar (NOT
+    ``block_until_ready`` — unreliable on the tunneled backend).
+    """
+    import collections
+    import glob
+    import gzip
+    import json
+    import os
+    import shutil
+    import tempfile
+
+    def fence(out) -> None:
+        leaf = jax.tree.leaves(out)[0]
+        float(leaf.ravel().astype("float32")[0])
+
+    fence(fn(*args))  # compile outside the trace
+    owns_dir = trace_dir is None
+    d = trace_dir or tempfile.mkdtemp(prefix="jax_op_breakdown_")
+    try:
+        with jax.profiler.trace(d):
+            out = None
+            for _ in range(iters):
+                out = fn(*args)
+            fence(out)
+        paths = sorted(
+            glob.glob(os.path.join(d, "plugins/profile/*/*.trace.json.gz"))
+        )
+        if not paths:
+            raise RuntimeError(f"no trace produced under {d}")
+        with gzip.open(paths[-1]) as f:
+            events = json.load(f)["traceEvents"]
+        pids = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                pids[e["pid"]] = e["args"].get("name", "")
+        durs: collections.Counter = collections.Counter()
+        for e in events:
+            pname = pids.get(e.get("pid"), "")
+            device_lane = (
+                "TPU" in pname or "device" in pname.lower() or "/gpu" in pname
+            )
+            if e.get("ph") == "X" and e.get("dur") and device_lane:
+                durs[e["name"]] += e["dur"]
+        rows = sorted(
+            ((v / iters / 1e3, k) for k, v in durs.items()), reverse=True
+        )
+        # the jit wrapper entry (if present) is the per-iter total
+        total = next(
+            (ms for ms, name in rows if name.startswith("jit_")),
+            sum(ms for ms, _ in rows),
+        )
+        return total, rows[:top]
+    finally:
+        if owns_dir:
+            shutil.rmtree(d, ignore_errors=True)
