@@ -5,3 +5,21 @@ comes from libtorch/Gloo/torchvision (SURVEY §2.2). Here the equivalent
 layer is Mosaic-compiled Pallas kernels for ops worth hand-scheduling
 beyond XLA's fusions.
 """
+
+from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention,
+)
+from cs744_pytorch_distributed_tutorial_tpu.ops.fused_conv import (  # noqa: F401
+    conv3x3,
+    conv3x3_wgrad,
+)
+from cs744_pytorch_distributed_tutorial_tpu.ops.fused_xent import (  # noqa: F401
+    fused_cross_entropy,
+)
+
+__all__ = [
+    "flash_attention",
+    "conv3x3",
+    "conv3x3_wgrad",
+    "fused_cross_entropy",
+]

@@ -21,8 +21,8 @@ def _nan_injecting(trainer, fail_at_call: int):
     real = trainer.train_step
     calls = {"n": 0}
 
-    def wrapped(params, opt_state, x, y):
-        p, o, m = real(params, opt_state, x, y)
+    def wrapped(params, opt_state, x, y, step=0):
+        p, o, m = real(params, opt_state, x, y, step)
         calls["n"] += 1
         if calls["n"] == fail_at_call:
             m = dict(m, loss=jnp.float32(float("nan")))
@@ -67,8 +67,8 @@ def test_lm_run_with_recovery_restarts_from_checkpoint(tmp_path):
     real = tr.train_step
     calls = {"n": 0}
 
-    def flaky(params, opt_state, x, y):
-        p, o, m = real(params, opt_state, x, y)
+    def flaky(params, opt_state, x, y, step=0):
+        p, o, m = real(params, opt_state, x, y, step)
         calls["n"] += 1
         if calls["n"] == 3:  # transient: fails once, clean on replay
             m = dict(m, loss=jnp.float32(float("inf")))
