@@ -56,6 +56,9 @@ def main() -> None:
 
         out = generate(params, prompt, jax.random.key(2))  # compile
         float(out[0, 0])
+        for _ in range(4):  # steady-state warm-up (see bench_lm.py)
+            out = generate(params, prompt, jax.random.key(2))
+        float(out[0, 0])
         t0 = time.perf_counter()
         for _ in range(REPEATS):
             out = generate(params, prompt, jax.random.key(2))
