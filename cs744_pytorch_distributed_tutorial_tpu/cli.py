@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"], default=None)
     p.add_argument("--fused-optimizer", action="store_true", default=None,
                    help="use the Pallas fused SGD kernel (ops/fused_sgd.py)")
+    p.add_argument("--fast-conv", action="store_true", default=None,
+                   help="Pallas wgrad backward for wide ResNet 3x3 convs "
+                        "(off by default; see benchmarks/ablate.py)")
+    p.add_argument("--no-augment", action="store_false", dest="augment",
+                   default=None,
+                   help="disable train-time crop/flip (deterministic inputs)")
     p.add_argument("--log-every", type=int, default=None)
     p.add_argument("--prefetch-depth", type=int, default=None,
                    help="batches staged ahead by the input pipeline (0 disables)")
@@ -120,6 +126,8 @@ def build_parser() -> argparse.ArgumentParser:
 _ARG_TO_FIELD = {
     "sync": "sync",
     "model": "model",
+    "fast_conv": "fast_conv",
+    "augment": "augment",
     "image_size": "image_size",
     "num_classes": "num_classes",
     "imagenet_stem": "imagenet_stem",

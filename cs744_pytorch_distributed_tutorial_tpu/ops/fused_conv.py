@@ -50,24 +50,20 @@ __all__ = ["conv3x3_wgrad", "conv3x3"]
 
 def _shift2d(xv: jnp.ndarray, dy: int, dx: int) -> jnp.ndarray:
     """``out[b, y, x, c] = xv[b, y+dy, x+dx, c]``, zero where out of
-    bounds. Pure value-level concats — Mosaic vector ops, VMEM only."""
-    b, h, w, c = xv.shape
+    bounds. Pure value-level concats — Mosaic vector ops, VMEM only.
+    The boundary zeros are derived from slices (``xv[...] * 0``) rather
+    than ``jnp.zeros``: in interpret mode the kernel inlines into the
+    enclosing trace, and under a check_vma shard_map a freshly created
+    (replicated) zeros array cannot concatenate with the device-varying
+    operand."""
     if dy == 1:
-        xv = jnp.concatenate(
-            [xv[:, 1:], jnp.zeros((b, 1, w, c), xv.dtype)], axis=1
-        )
+        xv = jnp.concatenate([xv[:, 1:], xv[:, :1] * 0], axis=1)
     elif dy == -1:
-        xv = jnp.concatenate(
-            [jnp.zeros((b, 1, w, c), xv.dtype), xv[:, :-1]], axis=1
-        )
+        xv = jnp.concatenate([xv[:, :1] * 0, xv[:, :-1]], axis=1)
     if dx == 1:
-        xv = jnp.concatenate(
-            [xv[:, :, 1:], jnp.zeros((b, h, 1, c), xv.dtype)], axis=2
-        )
+        xv = jnp.concatenate([xv[:, :, 1:], xv[:, :, :1] * 0], axis=2)
     elif dx == -1:
-        xv = jnp.concatenate(
-            [jnp.zeros((b, h, 1, c), xv.dtype), xv[:, :, :-1]], axis=2
-        )
+        xv = jnp.concatenate([xv[:, :, :1] * 0, xv[:, :, :-1]], axis=2)
     return xv
 
 
@@ -175,6 +171,28 @@ def conv3x3_wgrad(
         # CPU/virtual-mesh runs (tests, dryruns) execute the same kernel
         # through the interpreter — one code path, two backends.
         interpret = True
+    if interpret and getattr(jax.typeof(x), "vma", None):
+        # Inside a check_vma=True shard_map, interpret-mode pallas
+        # inlines the kernel into the vma-checked trace, where its
+        # replicated constants (scratch init, boundary zeros) cannot
+        # meet the device-varying operands. Use the reference
+        # formulation there — the kernel's numerics are pinned by the
+        # direct tests, and real TPU runs never take this branch. The
+        # vjp point is pcast varying so the result keeps the LOCAL-grad
+        # contract (no implicit psum).
+        def f(wk):
+            return lax.conv_general_dilated(
+                x, wk, (stride, stride), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+
+        w0 = jnp.zeros((3, 3, c, k), x.dtype)
+        vma = frozenset(getattr(jax.typeof(x), "vma", frozenset())) | frozenset(
+            getattr(jax.typeof(g), "vma", frozenset())
+        )
+        for name in sorted(vma):
+            w0 = lax.pcast(w0, name, to="varying")
+        return jax.vjp(f, w0)[1](g)[0].astype(jnp.float32)
 
     bb = block_batch or _pick_block_batch(b, h, w, c)
     if b % bb:
@@ -207,7 +225,18 @@ def conv3x3_wgrad(
             pl.BlockSpec((bb, ho, wo, kb), lambda j, i: (i, 0, 0, j)),
         ],
         out_specs=pl.BlockSpec((kb, 9 * c), lambda j, i: (j, 0)),
-        out_shape=jax.ShapeDtypeStruct((k, 9 * c), jnp.float32),
+        # Under a check_vma=True shard_map (the CIFAR engine), pallas
+        # outputs must declare their device-varying axes; the wgrad
+        # inherits the union of its operands' (activations vary over
+        # the data axis).
+        out_shape=jax.ShapeDtypeStruct(
+            (k, 9 * c),
+            jnp.float32,
+            vma=frozenset(
+                getattr(jax.typeof(x), "vma", None) or frozenset()
+            )
+            | frozenset(getattr(jax.typeof(g), "vma", None) or frozenset()),
+        ),
         scratch_shapes=[scratch],
         interpret=interpret,
     )(x, g)
@@ -237,13 +266,29 @@ def _conv3x3_fwd_rule(x, w, stride, interpret):
     return _conv_fwd(x, w, stride), (x, w)
 
 
+def _match_vma(val, like):
+    """psum ``val`` over the varying axes it carries beyond ``like``'s —
+    exactly the reduction AD's transpose would insert for a replicated
+    primal under a check_vma shard_map (the engine's 'auto' strategy);
+    a no-op when the primal is itself device-varying (manual
+    strategies, which pcast params before differentiating)."""
+    v_val = frozenset(getattr(jax.typeof(val), "vma", frozenset()) or ())
+    v_like = frozenset(getattr(jax.typeof(like), "vma", frozenset()) or ())
+    extra = tuple(sorted(v_val - v_like))
+    if extra:
+        from jax import lax as _lax
+
+        val = _lax.psum(val, extra)
+    return val
+
+
 def _conv3x3_bwd_rule(stride, interpret, res, g):
     x, w = res
     # dgrad via XLA's transposed conv (the emitter already at ceiling).
     _, dgrad = jax.vjp(lambda xx: _conv_fwd(xx, w, stride), x)
     (dx,) = dgrad(g)
     dw = conv3x3_wgrad(x, g, stride=stride, interpret=interpret)
-    return dx, dw.astype(w.dtype)
+    return _match_vma(dx, x), _match_vma(dw.astype(w.dtype), w)
 
 
 conv3x3.defvjp(_conv3x3_fwd_rule, _conv3x3_bwd_rule)

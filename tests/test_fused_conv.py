@@ -108,3 +108,38 @@ def test_fast_conv_resnet_grads_match():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4),
         g_ref, g_fast,
     )
+
+
+@pytest.mark.parametrize("sync", ["auto", "allreduce"])
+def test_fast_conv_engine_trajectory_parity(sync, mesh4):
+    """cfg.fast_conv through the REAL engine (check_vma shard_map, both
+    the framework-inserted and manual sync families) must reproduce the
+    nn.Conv trajectory: the custom VJP aligns its outputs' varying axes
+    with the primals (psum for replicated params under 'auto', no-op for
+    the pcast-varying manual strategies)."""
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    losses = {}
+    for fast in (False, True):
+        cfg = TrainConfig(
+            model="resnet18", sync=sync, num_devices=4,
+            global_batch_size=16, synthetic_data=True, fast_conv=fast,
+        )
+        tr = Trainer(cfg, mesh=mesh4)
+        state = tr.init()
+        ds = synthetic_cifar10(16, 8, seed=0)
+        x, y = shard_global_batch(
+            mesh4, ds.train_images[:16], ds.train_labels[:16]
+        )
+        key = jax.random.key(cfg.seed)
+        run = []
+        for _ in range(2):
+            state, m = tr.train_step(state, x, y, key)
+            run.append(float(m["loss"]))
+        losses[fast] = run
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
