@@ -46,3 +46,20 @@ def test_cli_end_to_end(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert '"final_eval_accuracy"' in out
+
+
+def test_round2_flags_map_to_config():
+    from cs744_pytorch_distributed_tutorial_tpu.cli import (
+        build_parser,
+        config_from_args,
+    )
+
+    args = build_parser().parse_args(
+        ["--model", "resnet18", "--fast-conv", "--no-augment"]
+    )
+    cfg = config_from_args(args)
+    assert cfg.fast_conv is True
+    assert cfg.augment is False
+    # defaults when the flags are absent
+    cfg2 = config_from_args(build_parser().parse_args([]))
+    assert cfg2.fast_conv is False and cfg2.augment is True
