@@ -62,6 +62,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-parallel", type=int, default=1)
     p.add_argument("--seq-parallel", type=int, default=1)
     p.add_argument("--tensor-parallel", type=int, default=1)
+    p.add_argument("--pipeline-parallel", type=int, default=1,
+                   help="stage the block stack over a pipe mesh axis "
+                        "(PipelineLMTrainer; composes with --data-parallel "
+                        "only — seq/tensor/MoE/generation stay on the "
+                        "shard_map engine)")
+    p.add_argument("--pipeline-schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"],
+                   help="gpipe: AD-derived reverse pipeline; 1f1b: "
+                        "hand-scheduled backward with a fixed 2S-1 "
+                        "activation stash")
+    p.add_argument("--num-microbatches", type=int, default=2)
     # optimization
     p.add_argument("--global-batch-size", type=int, default=8)
     p.add_argument("--seq-len", type=int, default=256)
@@ -106,6 +117,73 @@ def build_parser() -> argparse.ArgumentParser:
                    help="beam-search decode with K beams instead of sampling")
     p.add_argument("--json", action="store_true")
     return p
+
+
+def _run_pipeline(args, tokens, vocab: int) -> int:
+    """Pipeline-parallel training route (``--pipeline-parallel > 1``):
+    the block stack stages over a ``data x pipe`` mesh
+    (``parallel/pipeline.py``), GPipe or hand-scheduled 1F1B backward.
+    Orthogonal LM features (seq/tensor/MoE/eval/generation) stay on the
+    shard_map engine — combining them with staging is rejected rather
+    than silently ignored."""
+    import math
+
+    for flag, val, default in (
+        ("--seq-parallel", args.seq_parallel, 1),
+        ("--tensor-parallel", args.tensor_parallel, 1),
+        ("--moe-experts", args.moe_experts, 0),
+        ("--generate", args.generate, 0),
+        ("--eval-frac", args.eval_frac, 0.0),
+        ("--accum-steps", args.accum_steps, 1),
+    ):
+        if val != default:
+            raise SystemExit(
+                f"{flag} does not compose with --pipeline-parallel; the "
+                "pipeline engine stages the block stack only"
+            )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        PipelineLMConfig,
+        PipelineLMTrainer,
+    )
+
+    cfg = PipelineLMConfig(
+        vocab_size=vocab,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        d_model=args.d_model,
+        d_ff=args.d_ff,
+        max_seq_len=args.max_seq_len,
+        data_parallel=args.data_parallel,
+        pipeline_parallel=args.pipeline_parallel,
+        num_microbatches=args.num_microbatches,
+        schedule=args.pipeline_schedule,
+        remat=args.remat,
+        remat_policy=args.remat_policy,
+        global_batch_size=args.global_batch_size,
+        seq_len=args.seq_len,
+        learning_rate=args.lr,
+        seed=args.seed,
+    )
+    trainer = PipelineLMTrainer(cfg)
+    params, _, losses = trainer.fit(tokens, steps=args.steps)
+    for i, loss in enumerate(losses):
+        if i % args.log_every == 0 or i == len(losses) - 1:
+            print(f"{i} loss:  {loss:f}")
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "engine": "pipeline",
+                    "schedule": cfg.schedule,
+                    "pipeline_parallel": cfg.pipeline_parallel,
+                    "data_parallel": cfg.data_parallel,
+                    "num_microbatches": cfg.num_microbatches,
+                    "final_loss": losses[-1],
+                    "finite": bool(math.isfinite(losses[-1])),
+                }
+            )
+        )
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -170,6 +248,9 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
+    if args.pipeline_parallel > 1:
+        return _run_pipeline(args, tokens, vocab)
+
     eval_tokens = None
     if args.eval_frac > 0:
         if not 0.0 < args.eval_frac < 1.0:

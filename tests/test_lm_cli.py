@@ -74,3 +74,30 @@ def test_byte_corpus_windows(tmp_path):
 
     with pytest.raises(ValueError, match="bytes"):
         byte_corpus(str(f), 200)
+
+
+def test_pipeline_parallel_route(capsys):
+    """--pipeline-parallel routes to PipelineLMTrainer (gpipe or 1f1b);
+    incompatible flags are rejected, not silently dropped."""
+    import json as json_
+
+    import pytest
+
+    from cs744_pytorch_distributed_tutorial_tpu.lm_cli import main
+
+    rc = main([
+        "--pipeline-parallel", "2", "--pipeline-schedule", "1f1b",
+        "--data-parallel", "2", "--num-layers", "2", "--num-heads", "2",
+        "--d-model", "32", "--d-ff", "64", "--max-seq-len", "32",
+        "--seq-len", "16", "--global-batch-size", "8", "--num-seqs", "16",
+        "--steps", "2", "--log-every", "1", "--json",
+    ])
+    assert rc == 0
+    summary = json_.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["engine"] == "pipeline" and summary["finite"]
+
+    with pytest.raises(SystemExit, match="does not compose"):
+        main([
+            "--pipeline-parallel", "2", "--tensor-parallel", "2",
+            "--steps", "1",
+        ])
