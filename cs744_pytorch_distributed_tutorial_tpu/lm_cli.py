@@ -128,19 +128,48 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
     than silently ignored."""
     import math
 
+    # EVERY flag the pipeline engine cannot express is rejected — a
+    # silently dropped option would train a different configuration
+    # than the user asked for.
     for flag, val, default in (
         ("--seq-parallel", args.seq_parallel, 1),
         ("--tensor-parallel", args.tensor_parallel, 1),
         ("--moe-experts", args.moe_experts, 0),
         ("--generate", args.generate, 0),
+        ("--beam", args.beam, 0),
         ("--eval-frac", args.eval_frac, 0.0),
         ("--accum-steps", args.accum_steps, 1),
+        ("--dropout-rate", args.dropout_rate, 0.0),
+        ("--weight-decay", args.weight_decay, 1e-4),
+        ("--grad-clip-norm", args.grad_clip_norm, None),
+        ("--label-smoothing", args.label_smoothing, 0.0),
+        ("--optimizer", args.optimizer, "adamw"),
+        ("--lr-schedule", args.lr_schedule, "constant"),
+        ("--warmup-steps", args.warmup_steps, 0),
+        ("--checkpoint-dir", args.checkpoint_dir, None),
+        ("--checkpoint-every", args.checkpoint_every, 0),
+        ("--compute-dtype", args.compute_dtype, "float32"),
+        ("--fused-xent", args.fused_xent, False),
+        ("--tie-embeddings", args.tie_embeddings, False),
+        ("--use-rope", args.use_rope, False),
+        ("--num-kv-heads", args.num_kv_heads, None),
     ):
         if val != default:
             raise SystemExit(
                 f"{flag} does not compose with --pipeline-parallel; the "
-                "pipeline engine stages the block stack only"
+                "pipeline engine stages the plain block stack "
+                "(attention impl is selected by --attention-impl "
+                "dense|flash)"
             )
+    # "ring" is the parser's LM-engine default, meaningless on one
+    # sequence shard — map it to the pipeline engine's dense path;
+    # everything else must be chosen deliberately.
+    attn = "dense" if args.attention_impl == "ring" else args.attention_impl
+    if attn not in ("dense", "flash"):
+        raise SystemExit(
+            f"--attention-impl {args.attention_impl} does not compose with "
+            "--pipeline-parallel (the pipeline engine supports dense|flash)"
+        )
     from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
         PipelineLMConfig,
         PipelineLMTrainer,
@@ -157,6 +186,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         pipeline_parallel=args.pipeline_parallel,
         num_microbatches=args.num_microbatches,
         schedule=args.pipeline_schedule,
+        attention_impl=attn,
         remat=args.remat,
         remat_policy=args.remat_policy,
         global_batch_size=args.global_batch_size,
@@ -210,6 +240,11 @@ def main(argv: list[str] | None = None) -> int:
             args.num_seqs, args.seq_len, vocab, seed=args.seed
         )
 
+    # Route BEFORE constructing the shard_map engine's config: pipeline
+    # runs must not be subject to (or pay for) LMConfig's validation.
+    if args.pipeline_parallel > 1:
+        return _run_pipeline(args, tokens, vocab)
+
     cfg = LMConfig(
         vocab_size=vocab,
         num_layers=args.num_layers,
@@ -248,9 +283,6 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
-    if args.pipeline_parallel > 1:
-        return _run_pipeline(args, tokens, vocab)
-
     eval_tokens = None
     if args.eval_frac > 0:
         if not 0.0 < args.eval_frac < 1.0:

@@ -87,20 +87,36 @@ class Checkpointer:
             for p, m in jax.tree_util.tree_flatten_with_path(meta)[0]
         }
 
+        def _elastic_sharding(shape):
+            """Host-local sharding for a saved-shape restore target: the
+            recorded sharding can name device ids absent on the
+            (different-world) restoring host, and a single-device target
+            would concentrate large leaves on one HBM. Spread the
+            leading (world-sized) axis over as many local devices as
+            divide it."""
+            devs = jax.local_devices()
+            if not shape:
+                return jax.sharding.SingleDeviceSharding(devs[0])
+            n = 1
+            for d in range(min(len(devs), shape[0]), 0, -1):
+                if shape[0] % d == 0:
+                    n = d
+                    break
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            mesh = Mesh(np.asarray(devs[:n]), ("elastic",))
+            return NamedSharding(
+                mesh, PartitionSpec("elastic", *([None] * (len(shape) - 1)))
+            )
+
         def saved_shaped(path, leaf):
             m = meta_by_path.get(_path_key(path))
             if m is None or tuple(m.shape) == tuple(leaf.shape):
                 return leaf
-            # Explicit host-local sharding: left unset, Orbax restores
-            # with the sharding RECORDED in the checkpoint — which can
-            # name device ids that don't exist on the (different-world)
-            # restoring host, exactly the case this elastic path serves.
             return jax.ShapeDtypeStruct(
                 tuple(m.shape),
                 leaf.dtype,
-                sharding=jax.sharding.SingleDeviceSharding(
-                    jax.local_devices()[0]
-                ),
+                sharding=_elastic_sharding(tuple(m.shape)),
             )
 
         target = jax.tree_util.tree_map_with_path(saved_shaped, template)
