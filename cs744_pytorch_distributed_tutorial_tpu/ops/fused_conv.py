@@ -101,22 +101,25 @@ def _wgrad_kernel_s1(x_ref, g_ref, o_ref, acc_ref):
         o_ref[...] = acc_ref[...]
 
 
-def _wgrad_kernel_s2(x_ref, g_ref, o_ref, acc_ref):
+def _wgrad_kernel_s2(p00, p01, p10, p11, g_ref, o_ref, acc_ref):
     """Stride-2 SAME on even H, W (pad_lo=0, pad_hi=1): input row for
-    output row y' at tap dy is ``2y' + dy`` — parity ``dy % 2`` of a
-    [H/2, 2] split of H, shifted by ``dy // 2`` with a mask at the far
-    edge (the pad_hi row)."""
-    xv = x_ref[...]
-    bb, h, w, c = xv.shape
-    ho, wo = h // 2, w // 2
+    output row y' at tap dy is ``2y' + dy`` — parity ``dy % 2``, shifted
+    by ``dy // 2`` with the far edge (the pad_hi row) zeroed. The four
+    parity planes are de-interleaved OUTSIDE the kernel (cheap fused XLA
+    slices): Mosaic lowers neither the in-kernel [H/2, 2] reshape nor
+    strided vector slices, but plain shifts of pre-split planes it
+    handles fine — the same concat idiom as the stride-1 kernel."""
+    planes = {
+        (0, 0): p00[...], (0, 1): p01[...],
+        (1, 0): p10[...], (1, 1): p11[...],
+    }
+    bb, ho, wo, c = planes[(0, 0)].shape
     k = g_ref.shape[-1]
-    xs = xv.reshape(bb, ho, 2, wo, 2, c)
     taps = []
     for dy in (0, 1, 2):
         for dx in (0, 1, 2):
-            t = xs[:, :, dy % 2, :, dx % 2, :]  # [bb, ho, wo, c]
-            t = _shift2d(t, dy // 2, dx // 2)
-            taps.append(t)
+            t = planes[(dy % 2, dx % 2)]
+            taps.append(_shift2d(t, dy // 2, dx // 2))
     im2col = jnp.concatenate(taps, axis=-1).reshape(bb * ho * wo, 9 * c)
     gt = g_ref[...].reshape(bb * ho * wo, k).T
     contrib = lax.dot_general(
@@ -213,33 +216,49 @@ def conv3x3_wgrad(
         if _VMEM is not None
         else jax.ShapeDtypeStruct((kb, 9 * c), jnp.float32)
     )
+    # Under a check_vma=True shard_map (the CIFAR engine), pallas
+    # outputs must declare their device-varying axes; the wgrad
+    # inherits the union of its operands' (activations vary over
+    # the data axis).
+    out_shape = jax.ShapeDtypeStruct(
+        (k, 9 * c),
+        jnp.float32,
+        vma=frozenset(getattr(jax.typeof(x), "vma", None) or frozenset())
+        | frozenset(getattr(jax.typeof(g), "vma", None) or frozenset()),
+    )
+    g_spec = pl.BlockSpec((bb, ho, wo, kb), lambda j, i: (i, 0, 0, j))
+    out_spec = pl.BlockSpec((kb, 9 * c), lambda j, i: (j, 0))
     # Grid order (k_tile, batch): batch innermost, so the accumulator
     # finishes a full pass over B before the next K tile reinitializes
     # it. X blocks are re-read once per K tile — bounded, tiny traffic.
-    kernel = _wgrad_kernel_s1 if stride == 1 else _wgrad_kernel_s2
-    out = pl.pallas_call(
-        kernel,
-        grid=(k // kb, b // bb),
-        in_specs=[
-            pl.BlockSpec((bb, h, w, c), lambda j, i: (i, 0, 0, 0)),
-            pl.BlockSpec((bb, ho, wo, kb), lambda j, i: (i, 0, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((kb, 9 * c), lambda j, i: (j, 0)),
-        # Under a check_vma=True shard_map (the CIFAR engine), pallas
-        # outputs must declare their device-varying axes; the wgrad
-        # inherits the union of its operands' (activations vary over
-        # the data axis).
-        out_shape=jax.ShapeDtypeStruct(
-            (k, 9 * c),
-            jnp.float32,
-            vma=frozenset(
-                getattr(jax.typeof(x), "vma", None) or frozenset()
-            )
-            | frozenset(getattr(jax.typeof(g), "vma", None) or frozenset()),
-        ),
-        scratch_shapes=[scratch],
-        interpret=interpret,
-    )(x, g)
+    if stride == 1:
+        out = pl.pallas_call(
+            _wgrad_kernel_s1,
+            grid=(k // kb, b // bb),
+            in_specs=[
+                pl.BlockSpec((bb, h, w, c), lambda j, i: (i, 0, 0, 0)),
+                g_spec,
+            ],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            scratch_shapes=[scratch],
+            interpret=interpret,
+        )(x, g)
+    else:
+        # De-interleave the four stride-2 parity planes in XLA (fused
+        # strided slices) — Mosaic lowers neither the in-kernel
+        # [H/2, 2] reshape nor strided vector slices.
+        planes = [x[:, p::2, q::2, :] for p in (0, 1) for q in (0, 1)]
+        plane_spec = pl.BlockSpec((bb, ho, wo, c), lambda j, i: (i, 0, 0, 0))
+        out = pl.pallas_call(
+            _wgrad_kernel_s2,
+            grid=(k // kb, b // bb),
+            in_specs=[plane_spec] * 4 + [g_spec],
+            out_specs=out_spec,
+            out_shape=out_shape,
+            scratch_shapes=[scratch],
+            interpret=interpret,
+        )(*planes, g)
     # Kernel emits dW^T [K, 9C]; rows of 9C are tap-major/channel-minor.
     return out.T.reshape(3, 3, c, k)
 
