@@ -218,9 +218,21 @@ def _shard_map_decode(
         )
     from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import DATA_AXIS
 
-    tok_spec = (
-        PartitionSpec(DATA_AXIS) if DATA_AXIS in mesh.shape else PartitionSpec()
-    )
+    has_data = DATA_AXIS in mesh.shape
+    tok_spec = PartitionSpec(DATA_AXIS) if has_data else PartitionSpec()
+    if takes_key and has_data:
+        # The key enters replicated; without decorrelation every data
+        # shard would draw the identical per-row random stream (row i of
+        # each shard sampling with the same randomness). Fold the data
+        # coordinate in so shards sample independently. Tensor devices
+        # within a shard intentionally share the key — sampling
+        # decisions must stay replicated across the tensor axis.
+        inner = fn
+
+        def fn(params, prompt, key):  # noqa: F811 — deliberate rebind
+            key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+            return inner(params, prompt, key)
+
     in_specs = (param_specs, tok_spec) + (
         (PartitionSpec(),) if takes_key else ()
     )

@@ -164,10 +164,16 @@ def conv3x3_wgrad(
     ``g`` the output cotangent [B,Ho,Wo,K]."""
     b, h, w, c = x.shape
     gb, ho, wo, k = g.shape
-    assert gb == b and ho == h // stride and wo == w // stride, (
-        x.shape, g.shape, stride)
     if stride not in (1, 2):
         raise ValueError(f"stride {stride} unsupported (1 or 2)")
+    if gb != b or ho != h // stride or wo != w // stride:
+        # ValueError, not assert: a mismatched cotangent under python -O
+        # would otherwise reach the kernel and mis-accumulate opaquely.
+        raise ValueError(
+            f"cotangent shape {g.shape} inconsistent with input {x.shape} "
+            f"at stride {stride} (expected [{b}, {h // stride}, "
+            f"{w // stride}, K])"
+        )
     if stride == 2 and (h % 2 or w % 2):
         raise ValueError("stride-2 wgrad needs even H, W")
     if _VMEM is None or (not interpret and jax.default_backend() != "tpu"):

@@ -182,6 +182,21 @@ def one_f_one_b_pipeline(
     microbatch tail (final norm + head + loss) applied only at the last
     stage.
 
+    **Per-wave head cost (know before choosing '1f1b').** The
+    ``where(is_last, ...)`` select masks *values*, not *FLOPs*: lockstep
+    SPMD runs one program on every stage, so each backward wave computes
+    the tail forward AND its gradient — including the
+    ``[mb*t, d_model] @ [d_model, vocab]`` head projection — on all S
+    stages, with S-1 of them discarding the result. GPipe by contrast
+    applies the tail ONCE outside the schedule on the full batch. For
+    large vocabularies this makes a 1F1B wave materially more expensive
+    than a GPipe tick despite the equal tick *count* — pick '1f1b' for
+    its fixed-stash memory property, not for speed. Mitigation: a
+    ``tensor`` mesh axis shards the head over T devices, dividing the
+    per-wave tail cost accordingly (see ``PipelineLMTrainer`` with
+    ``tensor_parallel > 1``). Restructuring the select cannot help —
+    any program text present for the last stage executes everywhere.
+
     Returns ``(loss, d_stage_params, d_post_params, d_mb_inputs)`` —
     loss and the d_post/d_mb trees psum-replicated over the pipe axis,
     all averaged over microbatches.

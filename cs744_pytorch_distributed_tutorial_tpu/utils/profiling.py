@@ -103,6 +103,7 @@ def device_op_breakdown(
             if e.get("ph") == "M" and e.get("name") == "process_name":
                 pids[e["pid"]] = e["args"].get("name", "")
         durs: collections.Counter = collections.Counter()
+        by_lane: dict = collections.defaultdict(list)
         for e in events:
             pname = pids.get(e.get("pid"), "")
             device_lane = (
@@ -110,15 +111,29 @@ def device_op_breakdown(
             )
             if e.get("ph") == "X" and e.get("dur") and device_lane:
                 durs[e["name"]] += e["dur"]
+                by_lane[e.get("pid")].append((e.get("ts", 0.0), e["dur"]))
         rows = sorted(
             ((v / iters / 1e3, k) for k, v in durs.items()), reverse=True
         )
-        # the jit wrapper entry (if present) is the per-iter total
-        total = next(
-            (ms for ms, name in rows if name.startswith("jit_")),
-            sum(ms for ms, _ in rows),
-        )
-        return total, rows[:top]
+        # Per-iter total: sum of TOP-LEVEL device events only. Trace rows
+        # nest (a jit_ program contains its op rows; nested jits contain
+        # their callees), so summing every event double-counts
+        # parent+child, and "largest jit_ entry" under-counts when fn
+        # dispatches several programs back-to-back. Nesting is computed
+        # per device PID across all its tids: XLA puts the jit_ module
+        # event and its op events on DIFFERENT threads of the same
+        # device process, so per-(pid, tid) lanes would count both in
+        # full. Sort ties by -dur so a parent sharing its first child's
+        # start timestamp wins the top-level slot.
+        total_us = 0.0
+        for lane in by_lane.values():
+            lane.sort(key=lambda td: (td[0], -td[1]))
+            end = float("-inf")
+            for ts, dur in lane:
+                if ts >= end:
+                    total_us += dur
+                    end = ts + dur
+        return total_us / iters / 1e3, rows[:top]
     finally:
         if owns_dir:
             shutil.rmtree(d, ignore_errors=True)

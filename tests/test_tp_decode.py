@@ -110,6 +110,30 @@ def test_tp_generate_sampling_deterministic(tp_setup):
     assert ((0 <= a) & (a < 64)).all()
 
 
+def test_sampling_decorrelated_across_data_shards(tp_setup):
+    """Identical prompts landing on DIFFERENT data shards must draw
+    different random streams: the decode key is folded with the data
+    axis index inside shard_map (without it, row i of every shard
+    sampled identically — advisor finding, round 2)."""
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    tr, params = tp_setup
+    # 4 identical rows over data=2 -> rows 0,1 on shard 0, rows 2,3 on
+    # shard 1. Same in-shard index + same prompt would have collided.
+    prompt = np.asarray([[1, 2, 3, 4]] * 4, np.int32)
+    gen_tp = make_generator(
+        tr.tp_decode_model(), max_new_tokens=16, temperature=1.0,
+        mesh=tr.mesh, param_specs=tr.param_specs,
+    )
+    out = np.asarray(gen_tp(params, prompt, jax.random.key(7)))
+    # Within a shard, identical rows still share the per-shard stream
+    # only through different per-row key folds inside sample_tokens —
+    # the cross-shard pairs (0,2) and (1,3) are the regression surface.
+    assert not np.array_equal(out[0], out[2]) or not np.array_equal(
+        out[1], out[3]
+    )
+
+
 def test_tp_beam_matches_gathered(tp_setup):
     from cs744_pytorch_distributed_tutorial_tpu.infer import (
         make_beam_searcher,
