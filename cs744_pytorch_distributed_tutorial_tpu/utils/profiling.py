@@ -133,6 +133,12 @@ def device_op_breakdown(
                 if ts >= end:
                     total_us += dur
                     end = ts + dur
+                elif ts + dur > end:
+                    # Overlapping but not nested (e.g. a DMA straddling
+                    # a module boundary): count only the tail beyond the
+                    # current busy span — a true interval union.
+                    total_us += ts + dur - end
+                    end = ts + dur
         return total_us / iters / 1e3, rows[:top]
     finally:
         if owns_dir:
