@@ -1,0 +1,200 @@
+"""Round-3 probe: H-pair-packed fwd conv kernel for stage-1 shapes.
+
+The scored step's stem+stage1 region runs at ~35% MFU while the rest of
+the net runs at ~86% (benchmarks/breakdown_r3.py). The structural cause:
+every stage-1 matmul has a 64-wide output dim, half-filling the MXU's
+128 lanes. This kernel packs TWO output rows (h even/odd pair) into one
+128-wide output:
+
+    lhs  [B*16*32, 12C=768]  (4 input rows x 3 col-shifts im2col)
+    rhs  [768, 128]          (w packed: cols 0:64 even row, 64:128 odd)
+    out  [B*16*32, 128]      -> unpack to rows 2m / 2m+1
+
+Useful-MAC ratio 9/12 = 75%, but full K (768 = 6 tiles) and full N
+(128) — against the 50% lane ceiling of the naive [*, 576] @ [576, 64]
+form. The H-pair view [B, 16, 64, 64] is a FREE reshape of NHWC
+[B, 32, 32, 64] (row-major compatible), so both pallas boundaries stay
+bitcasts.
+
+Measures the kernel isolated vs XLA's in-step fused conv+stats
+(fusion.6-class ops, ~3.5 ms at batch 4096). Kill threshold from the
+round-3 plan: >= 3.2 ms means the owned-subgraph route cannot reach
+40k sps and the ablation gets written instead.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import os
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+def _shift_w3(t: jnp.ndarray, d: int) -> jnp.ndarray:
+    """W shift on a [bb, W, C] plane (dim 1), zero at the borders."""
+    if d == 1:
+        return jnp.concatenate([t[:, 1:], t[:, :1] * 0], axis=1)
+    if d == -1:
+        return jnp.concatenate([t[:, :1] * 0, t[:, :-1]], axis=1)
+    return t
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref):
+    """x_ref [bb, 16, 64, C] paired view; w_ref [12C, 128] packed;
+    o_ref [bb, 16, 64, K=C]. Inner fori over the 16 h-pairs keeps the
+    per-pair im2col [bb*32, 768] in VMEM budget (the whole-block
+    variant spilled 81 MB of vregs)."""
+    bb, h2, w2, c = x_ref.shape
+    w = w2 // 2
+    wmat = w_ref[...]
+
+    def pair(m, _):
+        pm1 = x_ref[:, pl.dslice(jnp.maximum(m - 1, 0), 1)][:, 0]
+        p0 = x_ref[:, pl.dslice(m, 1)][:, 0]
+        pp1 = x_ref[:, pl.dslice(jnp.minimum(m + 1, h2 - 1), 1)][:, 0]
+        # Row planes for outputs (2m, 2m+1): input rows 2m-1 .. 2m+2.
+        r0 = jnp.where(m > 0, pm1[:, w:, :], 0)   # row 2m-1
+        r1 = p0[:, :w, :]                         # row 2m
+        r2 = p0[:, w:, :]                         # row 2m+1
+        r3 = jnp.where(m < h2 - 1, pp1[:, :w, :], 0)  # row 2m+2
+        taps = [
+            _shift_w3(r, dx)
+            for r in (r0, r1, r2, r3)
+            for dx in (-1, 0, 1)
+        ]
+        lhs = jnp.concatenate(taps, axis=-1).reshape(bb * w, 12 * c)
+        out = lax.dot_general(
+            lhs, wmat, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        out = out.reshape(bb, w, 2 * c).astype(o_ref.dtype)
+        # Even rows live in lanes 0:C, odd in C:2C; two stores place
+        # them in the paired-view sublane halves (reshape, not None
+        # broadcast — the latter lowers as an unsupported gather).
+        o_ref[:, pl.dslice(m, 1), :w] = out[:, :, :c].reshape(bb, 1, w, c)
+        o_ref[:, pl.dslice(m, 1), w:] = out[:, :, c:].reshape(bb, 1, w, c)
+        return 0
+
+    lax.fori_loop(0, h2, pair, 0)
+
+
+def pack_weights(wk: jnp.ndarray) -> jnp.ndarray:
+    """[3, 3, C, K] -> [12C, 2K]: tap (r_off, dx) rows; cols 0:K = even
+    output row (ky = r_off), K:2K = odd (ky = r_off - 1)."""
+    k3, _, c, k = wk.shape
+    wp = np.zeros((4, 3, c, 2 * k), np.float32)
+    wnp = np.asarray(wk, np.float32)
+    for r_off in range(4):
+        for dx in range(3):
+            if r_off < 3:
+                wp[r_off, dx, :, :k] = wnp[r_off, dx]
+            if r_off >= 1:
+                wp[r_off, dx, :, k:] = wnp[r_off - 1, dx]
+    return jnp.asarray(wp.reshape(12 * c, 2 * k), jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("block_batch", "interpret"))
+def conv3x3_fwd_hpair(
+    x: jax.Array,
+    w_packed: jax.Array,
+    *,
+    block_batch: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    b, h, w, c = x.shape
+    xp = x.reshape(b, h // 2, 2 * w, c)  # free: row-major compatible
+    bb = block_batch
+    grid = (b // bb,)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bb, h // 2, 2 * w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((12 * c, 2 * c), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bb, h // 2, 2 * w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h // 2, 2 * w, c), x.dtype),
+        interpret=interpret,
+    )(xp, w_packed)
+    return out.reshape(b, h, w, c)
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() not in ("cpu",)
+    B, H, W, C = (4096, 32, 32, 64) if on_tpu else (16, 32, 32, 64)
+    key = jax.random.key(0)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (B, H, W, C), jnp.bfloat16)
+    wk = jax.random.normal(kw, (3, 3, C, C), jnp.float32) * 0.1
+    wp = pack_weights(wk)
+
+    # Correctness vs XLA conv.
+    ref_fn = jax.jit(
+        lambda xv, wv: lax.conv_general_dilated(
+            xv, wv.astype(jnp.bfloat16), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    )
+    ref = ref_fn(x, wk)
+    if on_tpu:
+        got = (
+            jax.jit(functools.partial(conv3x3_fwd_hpair, block_batch=32))
+            .lower(x, wp)
+            .compile(
+                compiler_options={"xla_tpu_scoped_vmem_limit_kib": "98304"}
+            )(x, wp)
+        )
+    else:
+        got = conv3x3_fwd_hpair(
+            x, wp, block_batch=min(B, 32), interpret=True
+        )
+    err = float(
+        jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32)))
+    )
+    scale = float(jnp.max(jnp.abs(ref.astype(jnp.float32)))) or 1.0
+    print(f"max abs err: {err:.4f} (rel {err / scale:.5f})")
+    assert err / scale < 5e-2, "numerics mismatch"
+    if not on_tpu:
+        print("CPU interpret mode: numerics only, no timing")
+        return
+
+    def bench(fn, *args):
+        out = fn(*args)
+        float(jnp.asarray(out).astype(jnp.float32).ravel()[0])
+        t0 = time.perf_counter()
+        for _ in range(20):
+            out = fn(*args)
+        float(jnp.asarray(out).astype(jnp.float32).ravel()[0])
+        return (time.perf_counter() - t0) / 20 * 1e3
+
+    for blk in (16, 32, 64, 128):
+        try:
+            fn = (
+                jax.jit(
+                    functools.partial(conv3x3_fwd_hpair, block_batch=blk)
+                )
+                .lower(x, wp)
+                .compile(
+                    compiler_options={
+                        "xla_tpu_scoped_vmem_limit_kib": "98304"
+                    }
+                )
+            )
+            t = bench(fn, x, wp)
+            print(f"hpair fwd  blk={blk}: {t:7.3f} ms")
+        except Exception as ex:
+            print(f"hpair fwd  blk={blk}: FAILED {str(ex)[:100]}")
+    t = bench(ref_fn, x, wk)
+    print(f"XLA conv isolated:  {t:7.3f} ms")
+
+
+if __name__ == "__main__":
+    main()
