@@ -96,8 +96,11 @@ def test_pipeline_parallel_route(capsys):
     summary = json_.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["engine"] == "pipeline" and summary["finite"]
 
+    # --tensor-parallel COMPOSES since the round-3 promotion (covered in
+    # test_pipeline.py); sequence parallelism genuinely cannot (each
+    # stage holds the full sequence) and must still be rejected.
     with pytest.raises(SystemExit, match="does not compose"):
         main([
-            "--pipeline-parallel", "2", "--tensor-parallel", "2",
+            "--pipeline-parallel", "2", "--seq-parallel", "2",
             "--steps", "1",
         ])
