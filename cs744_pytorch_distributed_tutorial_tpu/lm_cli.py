@@ -64,14 +64,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensor-parallel", type=int, default=1)
     p.add_argument("--pipeline-parallel", type=int, default=1,
                    help="stage the block stack over a pipe mesh axis "
-                        "(PipelineLMTrainer; composes with --data-parallel "
-                        "only — seq/tensor/MoE/generation stay on the "
+                        "(PipelineLMTrainer; composes with data/tensor "
+                        "parallelism, rope/GQA/flash/remat, MoE, the "
+                        "optimizer registry, checkpointing and eval — "
+                        "seq parallelism and generation stay on the "
                         "shard_map engine)")
     p.add_argument("--pipeline-schedule", default="gpipe",
-                   choices=["gpipe", "1f1b"],
+                   choices=["gpipe", "1f1b", "interleaved"],
                    help="gpipe: AD-derived reverse pipeline; 1f1b: "
                         "hand-scheduled backward with a fixed 2S-1 "
-                        "activation stash")
+                        "activation stash; interleaved: virtual-stage "
+                        "schedule cutting the bubble by "
+                        "1/num-virtual-stages")
+    p.add_argument("--num-virtual-stages", type=int, default=2,
+                   help="model chunks per device for "
+                        "--pipeline-schedule interleaved")
     p.add_argument("--num-microbatches", type=int, default=2)
     # optimization
     p.add_argument("--global-batch-size", type=int, default=8)
@@ -187,6 +194,17 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
             raise SystemExit(
                 f"{flag} does not compose with --pipeline-parallel ({why})"
             )
+    if (
+        args.num_virtual_stages != 2
+        and args.pipeline_schedule != "interleaved"
+    ):
+        # Same reject-don't-drop rule as above: a virtual-stage request
+        # on a non-interleaved schedule would silently train with the
+        # full (S-1) bubble.
+        raise SystemExit(
+            "--num-virtual-stages only applies to --pipeline-schedule "
+            f"interleaved (got schedule={args.pipeline_schedule!r})"
+        )
     # "ring" is the parser's LM-engine default, meaningless on one
     # sequence shard — map it to the pipeline engine's dense path;
     # everything else must be chosen deliberately.
@@ -219,6 +237,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         tensor_parallel=args.tensor_parallel,
         num_microbatches=args.num_microbatches,
         schedule=args.pipeline_schedule,
+        num_virtual_stages=args.num_virtual_stages,
         attention_impl=attn,
         remat=args.remat,
         remat_policy=args.remat_policy,
@@ -254,9 +273,13 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
                     "tensor_parallel": cfg.tensor_parallel,
                     "num_microbatches": cfg.num_microbatches,
                     "final_loss": losses[-1] if losses else None,
-                    "finite": bool(
-                        math.isfinite(losses[-1]) if losses else True
+                    # null when the run executed zero steps (checkpoint
+                    # already at --steps) — a gating script must not
+                    # read a no-op resume as a healthy training signal.
+                    "finite": (
+                        bool(math.isfinite(losses[-1])) if losses else None
                     ),
+                    "steps_run": len(losses),
                     "eval": eval_metrics,
                 }
             )

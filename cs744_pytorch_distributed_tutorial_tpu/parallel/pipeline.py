@@ -32,7 +32,6 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Any
 
 import flax.struct
@@ -138,6 +137,170 @@ def spmd_pipeline(
     return reduce_from_tp_region(
         jnp.where(stage == s - 1, outputs, jnp.zeros_like(outputs)), axis_name
     )
+
+
+# --------------------------------------------------------------------------
+# Interleaved (virtual-stage) schedule
+# --------------------------------------------------------------------------
+def spmd_pipeline_interleaved(
+    chunk_fn,
+    stage_chunks,
+    mb_inputs: jax.Array,
+    *,
+    axis_name: str,
+    num_stages: int,
+    num_microbatches: int,
+    num_chunks: int,
+) -> jax.Array:
+    """Virtual-stage pipeline: each device owns ``V = num_chunks`` model
+    chunks, round-robin over the ring — virtual stage ``j = v*S + d``
+    lives on device ``d = j % S``. The warmup/drain bubble shrinks to
+    ``S-1`` CHUNK-ticks per direction, i.e. 1/V of the plain schedule's
+    ``(S-1)`` full-stage ticks (``interleaved_stats``) — the property
+    the non-interleaved schedules cannot have.
+
+    The lockstep unit assignment is the mixed-radix decomposition
+
+        t - d = g*(V*S) + v*S + i,   0 <= v < V, 0 <= i < S
+
+    (microbatch ``m = g*S + i``, chunk ``v``): unique per (t, d), one
+    unit per device per tick, and one RING ppermute per tick carries
+    both the intra-chunk hop (d -> d+1) and the chunk transition
+    (S-1 -> 0, v -> v+1) — verified in the docgen tests tick-by-tick.
+    Microbatch groups of S fill each chunk before the next starts
+    (Megatron's grouped ordering), hence ``M % S == 0``.
+
+    The schedule is a differentiable ``lax.scan`` like ``spmd_pipeline``
+    — ``jax.grad`` of it IS the reversed interleaved pipeline (ppermute
+    transposes to the reversed ring), so the backward inherits the same
+    1/V bubble without a hand-written schedule.
+
+    Args:
+      chunk_fn: ``(chunk_params, x) -> y`` applied by every virtual
+        stage; ``chunk_params`` is one chunk's slice of
+        ``stage_chunks``.
+      stage_chunks: this device's stacked chunk params — leading dim
+        ``V * layers_per_vstage`` in INTERLEAVED storage order (chunk v
+        occupies rows ``[v*C, (v+1)*C)``).
+      mb_inputs: ``[M, ...]`` microbatched activations entering virtual
+        stage 0, replicated over the pipe axis.
+
+    Returns ``[M, ...]`` outputs of virtual stage ``V*S - 1``,
+    psum-broadcast over the axis (same contract as ``spmd_pipeline``).
+    """
+    s, m, v_chunks = num_stages, num_microbatches, num_chunks
+    if mb_inputs.shape[0] != m:
+        raise ValueError(
+            f"mb_inputs leading dim {mb_inputs.shape[0]} != num_microbatches {m}"
+        )
+    if m % s:
+        raise ValueError(
+            f"the interleaved schedule needs num_microbatches ({m}) "
+            f"divisible by the pipe axis ({s}) — microbatch groups of S "
+            "fill each chunk in turn"
+        )
+    layers_local = jax.tree.leaves(stage_chunks)[0].shape[0]
+    if layers_local % v_chunks:
+        raise ValueError(
+            f"per-device layer count {layers_local} not divisible by "
+            f"num_chunks {v_chunks}"
+        )
+    c = layers_local // v_chunks
+    stage = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % s) for i in range(s)] if s > 1 else None
+
+    # Megatron f boundary (identity fwd / psum bwd) for the same reason
+    # as spmd_pipeline: only virtual stage (0, 0) consumes mb_inputs.
+    mb_inputs = copy_to_tp_region(mb_inputs, axis_name)
+
+    state0 = jnp.zeros(mb_inputs.shape[1:], mb_inputs.dtype)
+    out0 = jnp.zeros_like(mb_inputs)
+
+    def tick(carry, t):
+        state, outputs = carry
+        r = t - stage
+        rc = jnp.clip(r, 0, v_chunks * m - 1)
+        g, rem = rc // (v_chunks * s), rc % (v_chunks * s)
+        v, i = rem // s, rem % s
+        m_idx = g * s + i
+        inject = lax.dynamic_index_in_dim(
+            mb_inputs, m_idx, axis=0, keepdims=False
+        )
+        x = jnp.where(jnp.logical_and(v == 0, stage == 0), inject, state)
+        chunk_params = jax.tree.map(
+            lambda a: lax.dynamic_slice_in_dim(a, v * c, c, axis=0),
+            stage_chunks,
+        )
+        y = chunk_fn(chunk_params, x)
+        write = jnp.logical_and(
+            jnp.logical_and(v == v_chunks - 1, stage == s - 1),
+            jnp.logical_and(r >= 0, r < v_chunks * m),
+        )
+        prev = lax.dynamic_index_in_dim(outputs, m_idx, axis=0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, prev), m_idx, axis=0
+        )
+        if ring is not None:
+            state = lax.ppermute(y, axis_name, perm=ring)
+        else:
+            state = y
+        return (state, outputs), None
+
+    total_ticks = v_chunks * m + s - 1
+    (_, outputs), _ = lax.scan(tick, (state0, out0), jnp.arange(total_ticks))
+    # Megatron g boundary on the way out, as in spmd_pipeline.
+    return reduce_from_tp_region(
+        jnp.where(stage == s - 1, outputs, jnp.zeros_like(outputs)), axis_name
+    )
+
+
+def interleave_layers(num_layers: int, num_stages: int, num_chunks: int):
+    """Storage order of the stacked layer dim for the interleaved
+    schedule: logical layer ``l`` belongs to virtual stage
+    ``j = l // C`` (``C = num_layers / (V*S)`` consecutive layers per
+    vstage), device ``j % S``, chunk ``j // S``; storage sorts by
+    (device, chunk, position) so each device's shard_map shard —
+    a CONTIGUOUS slice over the pipe axis — holds its V chunks stacked.
+    Returns (perm, inv) index arrays: ``storage = logical[perm]``,
+    ``logical = storage[inv]``."""
+    import numpy as np
+
+    vs = num_stages * num_chunks
+    if num_layers % vs:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by "
+            f"num_stages*num_chunks {vs}"
+        )
+    c = num_layers // vs
+    perm = np.empty(num_layers, np.int64)
+    idx = 0
+    for dev in range(num_stages):
+        for v in range(num_chunks):
+            j = v * num_stages + dev
+            for p in range(c):
+                perm[idx] = j * c + p
+                idx += 1
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(num_layers)
+    return perm, inv
+
+
+def interleaved_stats(
+    num_stages: int, num_microbatches: int, num_chunks: int
+) -> dict:
+    """Static bubble accounting, in CHUNK-ticks (one chunk-tick = 1/V of
+    a full-stage tick): both schedules do ``V*M`` busy chunk-ticks per
+    device per direction; the plain schedule idles ``(S-1)*V``
+    chunk-ticks, the interleaved one ``S-1`` — the 1/V cut."""
+    s, m, v = num_stages, num_microbatches, num_chunks
+    return {
+        "interleaved_ticks": v * m + s - 1,
+        "interleaved_idle_chunk_ticks": s - 1,
+        "plain_idle_chunk_ticks": (s - 1) * v,
+        "bubble_fraction": (s - 1) / (v * m + s - 1),
+        "plain_bubble_fraction": (s - 1) / (m + s - 1),
+        "bubble_cut_factor": v,
+    }
 
 
 # --------------------------------------------------------------------------
@@ -505,8 +668,16 @@ class PipelineLMConfig:
     # "gpipe": forward scan + AD-derived reverse pipeline (activation
     # stash grows with num_microbatches). "1f1b": hand-scheduled
     # one-forward-one-backward (one_f_one_b_pipeline) — same tick span,
-    # fixed 2S-1-slot stash, the large-M memory lever.
+    # fixed 2S-1-slot stash, the large-M memory lever. "interleaved":
+    # virtual-stage schedule (spmd_pipeline_interleaved) — each device
+    # owns num_virtual_stages chunks round-robin, cutting the
+    # warmup/drain bubble by 1/V in both directions (backward derived
+    # by AD of the interleaved forward).
     schedule: str = "gpipe"
+    # V for schedule="interleaved": model chunks per device. Requires
+    # num_layers % (pipeline_parallel * V) == 0 and
+    # num_microbatches % pipeline_parallel == 0.
+    num_virtual_stages: int = 2
     # Recompute block activations in backward (jax.checkpoint) — the GPipe
     # memory lever: without it every microbatch's per-layer activations
     # stay live until its backward tick.
@@ -604,10 +775,35 @@ class PipelineLMTrainer:
             )
         if cfg.seq_len > cfg.max_seq_len:
             raise ValueError(f"seq_len {cfg.seq_len} > max_seq_len {cfg.max_seq_len}")
-        if cfg.schedule not in ("gpipe", "1f1b"):
+        if cfg.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"unknown schedule {cfg.schedule!r}; choose 'gpipe' or '1f1b'"
+                f"unknown schedule {cfg.schedule!r}; choose 'gpipe', "
+                "'1f1b' or 'interleaved'"
             )
+        if cfg.schedule == "interleaved":
+            self.num_chunks = cfg.num_virtual_stages
+            if self.num_chunks < 1:
+                raise ValueError(
+                    f"num_virtual_stages must be >= 1, got {self.num_chunks}"
+                )
+            if cfg.num_layers % (self.pipe_size * self.num_chunks):
+                raise ValueError(
+                    f"num_layers {cfg.num_layers} not divisible by "
+                    f"pipe * num_virtual_stages "
+                    f"({self.pipe_size} * {self.num_chunks})"
+                )
+            if cfg.num_microbatches % self.pipe_size:
+                raise ValueError(
+                    f"the interleaved schedule needs num_microbatches "
+                    f"({cfg.num_microbatches}) divisible by the pipe axis "
+                    f"({self.pipe_size})"
+                )
+            self._perm, self._inv = interleave_layers(
+                cfg.num_layers, self.pipe_size, self.num_chunks
+            )
+        else:
+            self.num_chunks = 1
+            self._perm = self._inv = None
         if cfg.attention_impl not in ("dense", "flash"):
             raise ValueError(
                 f"unknown attention_impl {cfg.attention_impl!r}; the pipeline "
@@ -728,11 +924,31 @@ class PipelineLMTrainer:
             params["pos"] = init(kp, (cfg.max_seq_len, cfg.d_model))
         return params
 
+    def blocks_to_storage(self, blocks):
+        """Logical layer order -> the trainer's storage order (identity
+        unless schedule='interleaved', where storage sorts layers by
+        (device, chunk) so each pipe shard holds its V chunks stacked —
+        ``interleave_layers``). Host-side: fetches device arrays first
+        (a gather along a pipe-SHARDED dim would need collectives)."""
+        if self._perm is None:
+            return blocks
+        return jax.tree.map(lambda a: jax.device_get(a)[self._perm], blocks)
+
+    def blocks_to_logical(self, blocks):
+        """Inverse of ``blocks_to_storage`` (for comparing against the
+        unpipelined reference or exporting to a TransformerLM tree).
+        Host-side, like ``blocks_to_storage``."""
+        if self._inv is None:
+            return blocks
+        return jax.tree.map(lambda a: jax.device_get(a)[self._inv], blocks)
+
     def init(self, seed: int | None = None):
         """Host init at global shapes, laid out per the partition specs:
         block stack split over the pipe axis (and its kernels over the
-        tensor axis), the rest replicated."""
+        tensor axis), the rest replicated. Interleaved schedules store
+        the stacked layer dim in interleaved order (``interleave_layers``)."""
         params = self._init_host(self.cfg.seed if seed is None else seed)
+        params["blocks"] = self.blocks_to_storage(params["blocks"])
         opt_state = self.tx.init(params)
         put = lambda tree, specs: jax.tree.map(
             lambda x, s: host_to_global(x, NamedSharding(self.mesh, s)),
@@ -787,18 +1003,31 @@ class PipelineLMTrainer:
         has_tensor = TENSOR_AXIS in self.mesh.shape and self.tensor_size > 1
         stage_fn = self._stage_fn()
 
+        num_chunks = self.num_chunks
+
         def forward(params, tokens):
             b, t = tokens.shape
             x = self._embed(params, tokens)
             mb = x.reshape(m, b // m, t, cfg.d_model)
-            out = spmd_pipeline(
-                stage_fn,
-                params["blocks"],
-                mb,
-                axis_name=PIPE_AXIS,
-                num_stages=s,
-                num_microbatches=m,
-            )
+            if cfg.schedule == "interleaved":
+                out = spmd_pipeline_interleaved(
+                    stage_fn,
+                    params["blocks"],
+                    mb,
+                    axis_name=PIPE_AXIS,
+                    num_stages=s,
+                    num_microbatches=m,
+                    num_chunks=num_chunks,
+                )
+            else:
+                out = spmd_pipeline(
+                    stage_fn,
+                    params["blocks"],
+                    mb,
+                    axis_name=PIPE_AXIS,
+                    num_stages=s,
+                    num_microbatches=m,
+                )
             return self._tail(params, out.reshape(b, t, cfg.d_model))
 
         def sync_grad(g, spec):
@@ -932,22 +1161,13 @@ class PipelineLMTrainer:
         return self._tail(params_global, x)
 
     def evaluate(self, params, tokens) -> dict[str, float]:
-        """Held-out evaluation over ``tokens`` [N, seq_len + 1]: mean
-        next-token cross-entropy + perplexity, batched at
-        ``global_batch_size`` with a ragged tail dropped — the same
-        contract as ``LMTrainer.evaluate``."""
-        b = self.cfg.global_batch_size
-        n_batches = len(tokens) // b
-        if n_batches == 0:
-            raise ValueError(
-                f"need at least global_batch_size={b} sequences, got {len(tokens)}"
-            )
-        total = 0.0
-        for i in range(n_batches):
-            x, y = self.shard_batch(tokens[i * b : (i + 1) * b])
-            total += float(self.eval_step(params, x, y)["loss"])
-        mean_loss = total / n_batches
-        return {"loss": mean_loss, "perplexity": math.exp(mean_loss)}
+        """Held-out evaluation over ``tokens`` [N, seq_len + 1] — the
+        shared ``train/lm.py::evaluate_heldout`` contract."""
+        from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
+            evaluate_heldout,
+        )
+
+        return evaluate_heldout(self, params, tokens)
 
     def fit(self, tokens, steps: int):
         """Cycle batches from ``tokens`` [N, seq_len + 1]. With

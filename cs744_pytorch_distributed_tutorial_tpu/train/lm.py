@@ -42,6 +42,27 @@ SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
 
 
+def evaluate_heldout(trainer, params, tokens) -> dict[str, float]:
+    """Shared held-out evaluation contract (LM + pipeline engines):
+    mean next-token cross-entropy and perplexity (exp of it) over
+    ``tokens`` [N, seq_len + 1]. Batches of ``cfg.global_batch_size``
+    sequences; a ragged tail is dropped (like the train loaders'
+    drop_last) so every batch keeps the static shard shape. ``trainer``
+    needs ``cfg.global_batch_size``, ``shard_batch`` and ``eval_step``."""
+    b = trainer.cfg.global_batch_size
+    n_batches = len(tokens) // b
+    if n_batches == 0:
+        raise ValueError(
+            f"need at least global_batch_size={b} sequences, got {len(tokens)}"
+        )
+    total = 0.0
+    for i in range(n_batches):
+        x, y = trainer.shard_batch(tokens[i * b : (i + 1) * b])
+        total += float(trainer.eval_step(params, x, y)["loss"])
+    mean_loss = total / n_batches
+    return {"loss": mean_loss, "perplexity": math.exp(mean_loss)}
+
+
 @flax.struct.dataclass
 class LMState:
     """Checkpointable LM training state (utils/checkpoint.py keys saves
@@ -619,22 +640,8 @@ class LMTrainer:
 
     def evaluate(self, params, tokens) -> dict[str, float]:
         """Held-out evaluation over ``tokens`` [N, seq_len + 1]: mean
-        next-token cross-entropy and perplexity (exp of it). Batches of
-        ``global_batch_size`` sequences; a ragged tail is dropped (like
-        the train loaders' drop_last) so every batch keeps the static
-        shard shape."""
-        b = self.cfg.global_batch_size
-        n_batches = len(tokens) // b
-        if n_batches == 0:
-            raise ValueError(
-                f"need at least global_batch_size={b} sequences, got {len(tokens)}"
-            )
-        total = 0.0
-        for i in range(n_batches):
-            x, y = self.shard_batch(tokens[i * b : (i + 1) * b])
-            total += float(self.eval_step(params, x, y)["loss"])
-        mean_loss = total / n_batches
-        return {"loss": mean_loss, "perplexity": math.exp(mean_loss)}
+        next-token cross-entropy and perplexity (``evaluate_heldout``)."""
+        return evaluate_heldout(self, params, tokens)
 
     # ------------------------------------------------------------------ loop
     def fit(self, tokens, steps: int) -> tuple[Any, Any, list[float]]:

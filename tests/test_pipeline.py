@@ -322,21 +322,35 @@ def test_pipeline_rope_gqa_flash_remat_1f1b():
 
 def test_pipeline_moe_expert_parallel():
     """ep x pp: MoE blocks with experts sharded over the data axis
-    (all-to-all dispatch inside the stage function) train through the
-    pipeline schedule."""
-    tr = make_trainer(
-        data=2, pipe=2, layers=4, microbatches=2, batch=8,
-        moe_experts=4, moe_expert_parallel=True,
+    (all-to-all dispatch inside the stage function) train through BOTH
+    pipeline schedules, and the hand-scheduled 1F1B backward through the
+    all_to_all produces the same loss and updated params as AD of the
+    GPipe forward — the riskiest composition this promotion enables."""
+    results = {}
+    for schedule in ("gpipe", "1f1b"):
+        tr = make_trainer(
+            data=2, pipe=2, layers=4, microbatches=2, batch=8,
+            moe_experts=4, moe_expert_parallel=True, schedule=schedule,
+        )
+        toks = tokens_for(tr.cfg)
+        x, y = tr.shard_batch(toks)
+        params, opt = tr.init(0)
+        losses = []
+        for _ in range(3):
+            params, opt, m = tr.train_step(params, opt, x, y)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+        results[schedule] = (losses, params)
+    np.testing.assert_allclose(
+        results["1f1b"][0], results["gpipe"][0], rtol=1e-5
     )
-    toks = tokens_for(tr.cfg)
-    x, y = tr.shard_batch(toks)
-    params, opt = tr.init(0)
-    losses = []
-    for _ in range(3):
-        params, opt, m = tr.train_step(params, opt, x, y)
-        losses.append(float(m["loss"]))
-    assert all(np.isfinite(l) for l in losses)
-    assert losses[-1] < losses[0]
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            jax.device_get(a), jax.device_get(b), rtol=5e-4, atol=1e-6
+        ),
+        results["1f1b"][1], results["gpipe"][1],
+    )
 
 
 def test_pipeline_optimizer_registry():
@@ -385,6 +399,118 @@ def test_pipeline_checkpoint_resume_bit_identical(tmp_path):
         ),
         params_b, params_c,
     )
+
+
+# ---------------------------------------------------------------------------
+# Interleaved (virtual-stage) schedule
+# ---------------------------------------------------------------------------
+def test_interleaved_forward_parity_and_grads():
+    """V=2 virtual stages over S=2 devices: pipelined forward matches the
+    unpipelined reference on the same logical params; one train step
+    produces the SAME loss and (after storage->logical inverse
+    permutation) the same updated block params as gpipe."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        PipelineLMConfig,
+        PipelineLMTrainer,
+    )
+
+    cfg = PipelineLMConfig(
+        vocab_size=64, num_layers=8, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=64, data_parallel=1, pipeline_parallel=2,
+        num_microbatches=4, schedule="interleaved", num_virtual_stages=2,
+        global_batch_size=8, seq_len=16,
+    )
+    mesh = make_mesh({DATA_AXIS: 1, PIPE_AXIS: 2}, devices=jax.devices()[:2])
+    tr = PipelineLMTrainer(cfg, mesh=mesh)
+    params_global = tr._init_host(0)
+    params, opt = tr.init(0)
+    toks = tokens_for(cfg)
+    x = jnp.asarray(toks[:, :-1])
+    got = np.asarray(tr.forward_fn(params, x))
+    want = np.asarray(tr.reference_forward(params_global, x))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+    xg, yg = tr.shard_batch(toks)
+    p_i, _, m_i = tr.train_step(params, opt, xg, yg)
+
+    tr_g = PipelineLMTrainer(cfg.replace(schedule="gpipe"), mesh=mesh)
+    p_g, o_g = tr_g.init(0)
+    p_g, _, m_g = tr_g.train_step(p_g, o_g, xg, yg)
+    np.testing.assert_allclose(
+        float(m_i["loss"]), float(m_g["loss"]), rtol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            a, jax.device_get(b), rtol=5e-4, atol=1e-6
+        ),
+        tr.blocks_to_logical(p_i["blocks"]),
+        p_g["blocks"],
+    )
+
+
+def test_interleaved_v1_degenerates_to_plain_schedule():
+    """num_virtual_stages=1 must be exactly the plain spmd_pipeline
+    schedule (the mixed-radix unit assignment reduces to inject-at-t)."""
+    from jax.sharding import PartitionSpec as P
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        spmd_pipeline,
+        spmd_pipeline_interleaved,
+    )
+
+    mesh = make_mesh({PIPE_AXIS: 4}, devices=jax.devices()[:4])
+    m = 4
+    x = jnp.arange(m * 8, dtype=jnp.float32).reshape(m, 8)
+    chunks = jnp.ones((4, 1))  # 1 layer per vstage
+
+    def run(fn, **kw):
+        return jax.jit(
+            jax.shard_map(
+                lambda mb: fn(
+                    lambda p, h: h * 2.0 + p.sum(),
+                    chunks,
+                    mb,
+                    axis_name=PIPE_AXIS,
+                    num_stages=4,
+                    num_microbatches=m,
+                    **kw,
+                ),
+                mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False,
+            )
+        )(x)
+
+    plain = run(spmd_pipeline)
+    inter = run(spmd_pipeline_interleaved, num_chunks=1)
+    np.testing.assert_allclose(np.asarray(inter), np.asarray(plain))
+
+
+def test_interleaved_stats_bubble_cut():
+    """The schedule's reason to exist, statically: idle chunk-ticks drop
+    from (S-1)*V to S-1 — a clean 1/V bubble cut at equal busy work."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        interleaved_stats,
+    )
+
+    st = interleaved_stats(num_stages=4, num_microbatches=8, num_chunks=4)
+    assert st["interleaved_idle_chunk_ticks"] == 3
+    assert st["plain_idle_chunk_ticks"] == 12
+    assert st["bubble_cut_factor"] == 4
+    assert st["interleaved_ticks"] == 4 * 8 + 3
+    assert st["bubble_fraction"] < st["plain_bubble_fraction"]
+    # V=1 degenerates to the plain accounting
+    st1 = interleaved_stats(num_stages=4, num_microbatches=8, num_chunks=1)
+    assert st1["bubble_fraction"] == st1["plain_bubble_fraction"]
+
+
+def test_interleaved_validation():
+    with pytest.raises(ValueError, match="num_virtual_stages"):
+        make_trainer(
+            pipe=2, layers=6, schedule="interleaved", num_virtual_stages=2
+        )
+    with pytest.raises(ValueError, match="divisible by the pipe axis"):
+        make_trainer(
+            pipe=2, layers=8, microbatches=1, schedule="interleaved",
+            num_virtual_stages=2,
+        )
 
 
 def test_pipeline_evaluate_perplexity():
