@@ -76,9 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "activation stash; interleaved: virtual-stage "
                         "schedule cutting the bubble by "
                         "1/num-virtual-stages")
-    p.add_argument("--num-virtual-stages", type=int, default=2,
+    p.add_argument("--num-virtual-stages", type=int, default=None,
                    help="model chunks per device for "
-                        "--pipeline-schedule interleaved")
+                        "--pipeline-schedule interleaved (default 2); "
+                        "rejected on other schedules")
     p.add_argument("--num-microbatches", type=int, default=2)
     # optimization
     p.add_argument("--global-batch-size", type=int, default=8)
@@ -195,16 +196,18 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
                 f"{flag} does not compose with --pipeline-parallel ({why})"
             )
     if (
-        args.num_virtual_stages != 2
+        args.num_virtual_stages is not None
         and args.pipeline_schedule != "interleaved"
     ):
         # Same reject-don't-drop rule as above: a virtual-stage request
         # on a non-interleaved schedule would silently train with the
-        # full (S-1) bubble.
+        # full (S-1) bubble. The parser default is None so an EXPLICIT
+        # "--num-virtual-stages 2" is still caught.
         raise SystemExit(
             "--num-virtual-stages only applies to --pipeline-schedule "
             f"interleaved (got schedule={args.pipeline_schedule!r})"
         )
+    num_virtual = 2 if args.num_virtual_stages is None else args.num_virtual_stages
     # "ring" is the parser's LM-engine default, meaningless on one
     # sequence shard — map it to the pipeline engine's dense path;
     # everything else must be chosen deliberately.
@@ -237,7 +240,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         tensor_parallel=args.tensor_parallel,
         num_microbatches=args.num_microbatches,
         schedule=args.pipeline_schedule,
-        num_virtual_stages=args.num_virtual_stages,
+        num_virtual_stages=num_virtual,
         attention_impl=attn,
         remat=args.remat,
         remat_policy=args.remat_policy,

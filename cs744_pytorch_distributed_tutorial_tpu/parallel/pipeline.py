@@ -615,9 +615,15 @@ def stack_apply(
 @flax.struct.dataclass
 class PipelineLMState:
     """Checkpointable pipeline training state (utils/checkpoint.py keys
-    saves by ``step``) — same shape as ``train/lm.py::LMState``."""
+    saves by ``step``) — ``train/lm.py::LMState`` plus ``layout``: the
+    stacked-blocks storage-order code (0 = logical order;
+    ``S * 100000 + V`` = interleaved). Every leaf shape is identical
+    across layouts, so without this tag a resume under a different
+    schedule/num_virtual_stages would silently reassign layers to the
+    wrong virtual stages; ``fit`` refuses the mismatch instead."""
 
     step: jax.Array  # scalar int32
+    layout: jax.Array  # scalar int32 storage-order code
     params: Any
     opt_state: Any
 
@@ -804,6 +810,12 @@ class PipelineLMTrainer:
         else:
             self.num_chunks = 1
             self._perm = self._inv = None
+        # Storage-order code carried in checkpoints (PipelineLMState).
+        self._layout_code = (
+            self.pipe_size * 100000 + self.num_chunks
+            if self._perm is not None
+            else 0
+        )
         if cfg.attention_impl not in ("dense", "flash"):
             raise ValueError(
                 f"unknown attention_impl {cfg.attention_impl!r}; the pipeline "
@@ -1186,9 +1198,24 @@ class PipelineLMTrainer:
 
             ckpt = Checkpointer(cfg.checkpoint_dir)
             restored = ckpt.restore_latest(
-                PipelineLMState(jnp.zeros((), jnp.int32), params, opt_state)
+                PipelineLMState(
+                    jnp.zeros((), jnp.int32),
+                    jnp.asarray(self._layout_code, jnp.int32),
+                    params,
+                    opt_state,
+                )
             )
             if restored is not None:
+                saved_layout = int(jax.device_get(restored.layout))
+                if saved_layout != self._layout_code:
+                    raise ValueError(
+                        f"checkpoint {cfg.checkpoint_dir!r} stores blocks "
+                        f"in layer-storage layout {saved_layout}, this "
+                        f"trainer uses {self._layout_code} "
+                        "(schedule/num_virtual_stages changed?) — every "
+                        "leaf shape matches, so resuming would silently "
+                        "assign layers to the wrong virtual stages"
+                    )
                 start_step = int(jax.device_get(restored.step))
                 params, opt_state = restored.params, restored.opt_state
         losses: list[float] = []
@@ -1207,12 +1234,22 @@ class PipelineLMTrainer:
                     and (step + 1) % cfg.checkpoint_every == 0
                 ):
                     ckpt.save(
-                        PipelineLMState(jnp.int32(step + 1), params, opt_state)
+                        PipelineLMState(
+                            jnp.int32(step + 1),
+                            jnp.asarray(self._layout_code, jnp.int32),
+                            params,
+                            opt_state,
+                        )
                     )
             if ckpt is not None:
                 final = max(steps, start_step)
                 ckpt.save(
-                    PipelineLMState(jnp.int32(final), params, opt_state),
+                    PipelineLMState(
+                        jnp.int32(final),
+                        jnp.asarray(self._layout_code, jnp.int32),
+                        params,
+                        opt_state,
+                    ),
                     force=True,
                 )
         finally:
