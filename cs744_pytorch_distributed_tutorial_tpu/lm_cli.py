@@ -316,6 +316,13 @@ def main(argv: list[str] | None = None) -> int:
 
     # Route BEFORE constructing the shard_map engine's config: pipeline
     # runs must not be subject to (or pay for) LMConfig's validation.
+    if args.pipeline_parallel <= 1 and args.num_virtual_stages is not None:
+        # Reject-don't-drop on BOTH routes: without a pipe axis the
+        # virtual-stage request would be silently ignored here.
+        raise SystemExit(
+            "--num-virtual-stages requires --pipeline-parallel > 1 "
+            "(virtual stages interleave over the pipe axis)"
+        )
     if args.pipeline_parallel > 1:
         return _run_pipeline(args, tokens, vocab)
 

@@ -804,9 +804,15 @@ class PipelineLMTrainer:
                     f"({cfg.num_microbatches}) divisible by the pipe axis "
                     f"({self.pipe_size})"
                 )
-            self._perm, self._inv = interleave_layers(
-                cfg.num_layers, self.pipe_size, self.num_chunks
-            )
+            if self.num_chunks > 1:
+                self._perm, self._inv = interleave_layers(
+                    cfg.num_layers, self.pipe_size, self.num_chunks
+                )
+            else:
+                # V=1 interleaving is the identity permutation — same
+                # storage as the plain schedules (layout code 0 below,
+                # so resumes across the two are not falsely refused).
+                self._perm = self._inv = None
         else:
             self.num_chunks = 1
             self._perm = self._inv = None
@@ -1172,6 +1178,16 @@ class PipelineLMTrainer:
         )[0]
         return self._tail(params_global, x)
 
+    def _make_state(self, step, params, opt_state) -> "PipelineLMState":
+        """The checkpointable state at this trainer's storage layout
+        (single construction point for fit()'s save/restore sites)."""
+        return PipelineLMState(
+            jnp.asarray(step, jnp.int32),
+            jnp.asarray(self._layout_code, jnp.int32),
+            params,
+            opt_state,
+        )
+
     def evaluate(self, params, tokens) -> dict[str, float]:
         """Held-out evaluation over ``tokens`` [N, seq_len + 1] — the
         shared ``train/lm.py::evaluate_heldout`` contract."""
@@ -1197,14 +1213,20 @@ class PipelineLMTrainer:
             )
 
             ckpt = Checkpointer(cfg.checkpoint_dir)
-            restored = ckpt.restore_latest(
-                PipelineLMState(
-                    jnp.zeros((), jnp.int32),
-                    jnp.asarray(self._layout_code, jnp.int32),
-                    params,
-                    opt_state,
+            try:
+                restored = ckpt.restore_latest(
+                    self._make_state(jnp.zeros((), jnp.int32), params, opt_state)
                 )
-            )
+            except ValueError as e:
+                if "layout" in str(e):
+                    raise ValueError(
+                        f"checkpoint {cfg.checkpoint_dir!r} predates the "
+                        "round-3 'layout' field of PipelineLMState and "
+                        "cannot be resumed by this version; re-train or "
+                        "re-save it (its blocks are in logical order — "
+                        "layout code 0)"
+                    ) from e
+                raise
             if restored is not None:
                 saved_layout = int(jax.device_get(restored.layout))
                 if saved_layout != self._layout_code:
@@ -1234,22 +1256,12 @@ class PipelineLMTrainer:
                     and (step + 1) % cfg.checkpoint_every == 0
                 ):
                     ckpt.save(
-                        PipelineLMState(
-                            jnp.int32(step + 1),
-                            jnp.asarray(self._layout_code, jnp.int32),
-                            params,
-                            opt_state,
-                        )
+                        self._make_state(step + 1, params, opt_state)
                     )
             if ckpt is not None:
                 final = max(steps, start_step)
                 ckpt.save(
-                    PipelineLMState(
-                        jnp.int32(final),
-                        jnp.asarray(self._layout_code, jnp.int32),
-                        params,
-                        opt_state,
-                    ),
+                    self._make_state(final, params, opt_state),
                     force=True,
                 )
         finally:

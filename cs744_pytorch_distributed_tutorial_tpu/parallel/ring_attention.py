@@ -161,10 +161,10 @@ def ring_attention(
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
 
-    def step(s, carry):
-        kb, vb, m, l, o = carry
+    def merge(kb, vb, m, l, o, s):
+        """Fold the held K/V block (home device ``(idx - s) % N``) into
+        the flash-style running accumulators."""
         kb_w, vb_w = widen(kb), widen(vb)
-        # Global offset of the K/V block currently held: its home device.
         k_off = ((idx - s) % axis_size) * t_local
         scores = (
             jnp.einsum(
@@ -185,18 +185,28 @@ def ring_attention(
             preferred_element_type=jnp.float32,
         )
         o_new = o * correction.transpose(0, 2, 1)[..., None] + pv
-        # Rotate K/V one neighbor up the ring (skip the final dead hop).
-        kb, vb = lax.cond(
-            s < axis_size - 1,
-            lambda kv: tuple(
-                lax.ppermute(x, axis_name, perm=up) for x in kv
-            ),
-            lambda kv: kv,
-            (kb, vb),
-        )
-        return kb, vb, m_new, l_new, o_new
+        return m_new, l_new, o_new
 
-    _, _, _, l, o = lax.fori_loop(0, axis_size, step, (k, v, m0, l0, o0))
+    def step(s, carry):
+        kb, vb, m, l, o = carry
+        # Overlap-capable (double-buffered) hop structure: the transfers
+        # are issued UNCONDITIONALLY, on the same operands the compute
+        # reads — no data dependence ties the hop's ICI transfer to the
+        # hop's attention math, so the latency-hiding scheduler may run
+        # them concurrently (the in-flight blocks land in the next
+        # tick's carry). A lax.cond around the ppermute — the round-2
+        # formulation — made the collective conditional and therefore
+        # unschedulable as async; the dead final transfer is avoided by
+        # PEELING the last merge below instead.
+        kb_next = lax.ppermute(kb, axis_name, perm=up)
+        vb_next = lax.ppermute(vb, axis_name, perm=up)
+        m, l, o = merge(kb, vb, m, l, o, s)
+        return kb_next, vb_next, m, l, o
+
+    kb, vb, m, l, o = lax.fori_loop(
+        0, axis_size - 1, step, (k, v, m0, l0, o0)
+    )
+    _, l, o = merge(kb, vb, m, l, o, axis_size - 1)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(v.dtype)
 
@@ -258,8 +268,7 @@ def _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret):
     o0 = jnp.zeros((b * h, t, d), jnp.float32)
     lse0 = jnp.full((b * h, t, 1), _MASK, jnp.float32)
 
-    def hop(s, carry):
-        kb, vb, o_acc, lse_acc = carry
+    def merge(kb, vb, o_acc, lse_acc, s):
         k_blk = (idx - s) % axis_size
 
         def compute(hop_causal):
@@ -283,15 +292,24 @@ def _rfa_forward(q, k, v, axis_name, axis_size, causal, interpret):
         o_new = o_acc * jnp.exp(lse_acc - new_lse) + out_h * jnp.exp(
             lse_h - new_lse
         )
-        kb, vb = lax.cond(
-            s < axis_size - 1,
-            lambda kv: tuple(lax.ppermute(x, axis_name, perm=up) for x in kv),
-            lambda kv: kv,
-            (kb, vb),
-        )
-        return kb, vb, o_new, new_lse
+        return o_new, new_lse
 
-    _, _, o_acc, lse = lax.fori_loop(0, axis_size, hop, (k, v, o0, lse0))
+    def hop(s, carry):
+        kb, vb, o_acc, lse_acc = carry
+        # Unconditional transfers co-issued with the hop's kernel (see
+        # ring_attention.step): the ppermutes read the same kb/vb the
+        # kernel does and nothing downstream in this tick consumes
+        # their results, so transfer and compute may overlap. The dead
+        # final transfer is avoided by peeling the last merge.
+        kb_next = lax.ppermute(kb, axis_name, perm=up)
+        vb_next = lax.ppermute(vb, axis_name, perm=up)
+        o_acc, lse_acc = merge(kb, vb, o_acc, lse_acc, s)
+        return kb_next, vb_next, o_acc, lse_acc
+
+    kb, vb, o_acc, lse = lax.fori_loop(
+        0, axis_size - 1, hop, (k, v, o0, lse0)
+    )
+    o_acc, lse = merge(kb, vb, o_acc, lse, axis_size - 1)
     return _from_bh(o_acc, b, t, h, d).astype(v.dtype), lse
 
 

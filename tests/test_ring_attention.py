@@ -213,3 +213,109 @@ def test_single_device_axis_is_dense(qkv):
         np.asarray(dense_attention(q, k, v, causal=True)),
         rtol=1e-6,
     )
+
+
+# ---------------------------------------------------------------------------
+# Overlap-capable ring structure (round 3)
+# ---------------------------------------------------------------------------
+def _find_while_bodies(jaxpr, bodies=None):
+    """Collect every loop body jaxpr (fori_loop lowers to ``scan`` for
+    static trip counts, ``while`` otherwise) reachable from ``jaxpr``."""
+    if bodies is None:
+        bodies = []
+
+    def subjaxprs(eqn):
+        for v in eqn.params.values():
+            for cand in v if isinstance(v, (list, tuple)) else [v]:
+                if hasattr(cand, "eqns"):
+                    yield cand
+                elif hasattr(cand, "jaxpr"):
+                    yield cand.jaxpr
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in ("while", "scan"):
+            bodies.extend(subjaxprs(eqn))
+        for inner in subjaxprs(eqn):
+            _find_while_bodies(inner, bodies)
+    return bodies
+
+
+def _ring_body_ppermutes(fn, mesh, q, k, v, n):
+    """Trace the shard_mapped ring fn and return, for its hop-loop body:
+    (top-level ppermute eqns, whether any ppermute hides inside a cond,
+    whether any ppermute output feeds another eqn in the same body)."""
+    mapped = jax.shard_map(
+        lambda a, b, c: fn(a, b, c, "data", n),
+        mesh=mesh,
+        in_specs=(P(None, "data"),) * 3,
+        out_specs=P(None, "data"),
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(mapped)(q, k, v)
+    bodies = _find_while_bodies(jaxpr.jaxpr)
+    assert bodies, "no while loop found in the traced ring attention"
+    # The hop loop is the body that carries ppermutes at its top level.
+    for body in bodies:
+        perms = [e for e in body.eqns if e.primitive.name == "ppermute"]
+        if not perms:
+            continue
+        in_cond = any(
+            inner_e.primitive.name == "ppermute"
+            for e in body.eqns
+            if e.primitive.name == "cond"
+            for br in e.params["branches"]
+            for inner_e in br.jaxpr.eqns
+        )
+        perm_outs = {str(o) for e in perms for o in e.outvars}
+        consumed = any(
+            str(iv) in perm_outs
+            for e in body.eqns
+            if e.primitive.name != "ppermute"
+            for iv in e.invars
+            if not isinstance(iv, jax.extend.core.Literal)
+        )
+        return perms, in_cond, consumed
+    raise AssertionError("no while body carries top-level ppermutes")
+
+
+def test_ring_hop_structure_is_overlap_capable(mesh8, qkv):
+    """The round-3 restructure (VERDICT r2 #7): each hop-loop tick must
+    issue BOTH block transfers (k and v ppermutes) unconditionally at
+    the body's top level — a lax.cond-wrapped collective cannot be
+    scheduled async — and nothing else in the tick may consume their
+    results (they flow straight to the carry), so the ICI transfer and
+    the hop's attention math are schedulable concurrently."""
+    q, k, v = qkv
+    for fn in (
+        lambda a, b, c, ax, n: ring_attention(a, b, c, ax, n, causal=True),
+        lambda a, b, c, ax, n: ring_flash_attention(
+            a, b, c, ax, n, True, True
+        ),
+    ):
+        perms, in_cond, consumed = _ring_body_ppermutes(fn, mesh8, q, k, v, 8)
+        assert len(perms) == 2, f"expected k+v ppermutes per tick, got {len(perms)}"
+        assert not in_cond, "ppermute wrapped in lax.cond — not async-schedulable"
+        assert not consumed, "a ppermute output is consumed inside its own tick"
+
+
+def test_ring_peeled_final_hop_count(mesh8, qkv):
+    """The dead final transfer is peeled, not cond-guarded: the hop loop
+    trips axis_size - 1 times (its bound rides the carry as a literal in
+    the cond jaxpr; cheaper to check behaviorally — parity above — plus
+    structurally: exactly one while body carries the ppermutes)."""
+    q, k, v = qkv
+    mapped = jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "data", 8, causal=False),
+        mesh=mesh8,
+        in_specs=(P(None, "data"),) * 3,
+        out_specs=P(None, "data"),
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(mapped)(q, k, v)
+    bodies = _find_while_bodies(jaxpr.jaxpr)
+    with_perms = [
+        b
+        for b in bodies
+        if any(e.primitive.name == "ppermute" for e in b.eqns)
+    ]
+    assert len(with_perms) == 1
