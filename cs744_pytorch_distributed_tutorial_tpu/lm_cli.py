@@ -180,8 +180,6 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
          "decode runs on the shard_map engine"),
         ("--accum-steps", args.accum_steps, 1,
          "microbatching IS the pipeline's accumulation"),
-        ("--dropout-rate", args.dropout_rate, 0.0,
-         "rng streams are not plumbed through the pipeline schedules"),
         ("--grad-clip-norm", args.grad_clip_norm, None,
          "pipe-stage-sharded grads have no global norm"),
         ("--label-smoothing", args.label_smoothing, 0.0,
@@ -195,6 +193,15 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
             raise SystemExit(
                 f"{flag} does not compose with --pipeline-parallel ({why})"
             )
+    if args.dropout_rate != 0.0 and args.pipeline_schedule == "interleaved":
+        # The interleaved chunk slices carry no layer identity for the
+        # mask stream yet; reject with the CLI's message format rather
+        # than surfacing the trainer's ValueError as a traceback.
+        raise SystemExit(
+            "--dropout-rate does not compose with --pipeline-schedule "
+            "interleaved (chunk slices carry no layer identity for the "
+            "mask stream); use gpipe or 1f1b"
+        )
     if (
         args.num_virtual_stages is not None
         and args.pipeline_schedule != "interleaved"
@@ -248,6 +255,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         seq_len=args.seq_len,
         learning_rate=args.lr,
         seed=args.seed,
+        dropout_rate=args.dropout_rate,
         optimizer=args.optimizer,
         lr_schedule=args.lr_schedule,
         warmup_steps=args.warmup_steps,

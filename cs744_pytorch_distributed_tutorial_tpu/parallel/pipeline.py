@@ -66,12 +66,17 @@ def spmd_pipeline(
     axis_name: str,
     num_stages: int,
     num_microbatches: int,
+    pass_mb_index: bool = False,
 ) -> jax.Array:
     """Run ``mb_inputs`` through ``num_stages`` pipeline stages.
 
     Args:
       stage_fn: ``(stage_params, x) -> y``, shape-preserving; applied by
-        every stage to its current microbatch activation.
+        every stage to its current microbatch activation. With
+        ``pass_mb_index=True`` the signature is
+        ``(stage_params, x, mb_idx)`` — ``mb_idx`` is the (clamped)
+        index of the microbatch this stage is processing this tick, the
+        identity a per-microbatch rng stream (dropout) needs.
       stage_params: this stage's parameter shard (the local view under
         ``shard_map`` of a pytree sharded over ``axis_name``).
       mb_inputs: ``[M, ...]`` microbatched activations entering stage 0,
@@ -112,7 +117,13 @@ def spmd_pipeline(
             mb_inputs, jnp.minimum(t, m - 1), axis=0, keepdims=False
         )
         x = jnp.where(stage == 0, inject, state)
-        y = stage_fn(stage_params, x)
+        if pass_mb_index:
+            # Microbatch this stage processes this tick: it entered the
+            # pipeline stage-many ticks ago (clamped during warmup/drain
+            # ticks, whose results are never recorded).
+            y = stage_fn(stage_params, x, jnp.clip(t - stage, 0, m - 1))
+        else:
+            y = stage_fn(stage_params, x)
         # The last stage records microbatch t-(S-1) once it has flowed
         # through all S stages; earlier ticks (warmup) write nothing.
         out_idx = jnp.clip(t - (s - 1), 0, m - 1)
@@ -151,6 +162,7 @@ def spmd_pipeline_interleaved(
     num_stages: int,
     num_microbatches: int,
     num_chunks: int,
+    pass_mb_index: bool = False,  # see guard below
 ) -> jax.Array:
     """Virtual-stage pipeline: each device owns ``V = num_chunks`` model
     chunks, round-robin over the ring — virtual stage ``j = v*S + d``
@@ -188,6 +200,17 @@ def spmd_pipeline_interleaved(
     Returns ``[M, ...]`` outputs of virtual stage ``V*S - 1``,
     psum-broadcast over the axis (same contract as ``spmd_pipeline``).
     """
+    if pass_mb_index:
+        # The microbatch index alone is NOT enough identity here: a
+        # device's V chunks would draw identical per-microbatch rng
+        # streams (the layer-identity hazard the trainer's rejection
+        # cites). Until (chunk, layer) ids are threaded through
+        # chunk_fn, refuse rather than ship wrong masks.
+        raise NotImplementedError(
+            "pass_mb_index on the interleaved schedule needs (chunk, "
+            "layer) identity threaded through chunk_fn; use gpipe/1f1b "
+            "for per-microbatch rng streams"
+        )
     s, m, v_chunks = num_stages, num_microbatches, num_chunks
     if mb_inputs.shape[0] != m:
         raise ValueError(
@@ -317,6 +340,7 @@ def one_f_one_b_pipeline(
     axis_name: str,
     num_stages: int,
     num_microbatches: int,
+    pass_mb_index: bool = False,
 ):
     """One-forward-one-backward schedule with the backward written out
     explicitly (recompute + per-stage VJP) instead of derived by AD of
@@ -380,6 +404,14 @@ def one_f_one_b_pipeline(
     mb_shape = mb_inputs.shape[1:]
     is_last = stage == s - 1
 
+    def apply_stage(sp, x, mb_idx):
+        """The per-microbatch rng identity (dropout) keys off mb_idx;
+        the backward recompute passes the SAME index, so masks replay
+        exactly."""
+        if pass_mb_index:
+            return stage_fn(sp, x, mb_idx)
+        return stage_fn(sp, x)
+
     def fwd_half(fwd_carry, stash, t):
         """Wave-t forward: stage d forwards microbatch t - d."""
         fwd_idx = t - stage
@@ -388,7 +420,7 @@ def one_f_one_b_pipeline(
             mb_inputs, jnp.clip(t, 0, m - 1), axis=0, keepdims=False
         )
         x_in = jnp.where(stage == 0, inject, fwd_carry)
-        y = stage_fn(stage_params, x_in)
+        y = apply_stage(stage_params, x_in, jnp.clip(fwd_idx, 0, m - 1))
         slot = jnp.clip(fwd_idx, 0, m - 1) % n_slots
         prev = lax.dynamic_index_in_dim(stash, slot, axis=0, keepdims=False)
         stash = lax.dynamic_update_index_in_dim(
@@ -412,7 +444,7 @@ def one_f_one_b_pipeline(
         g_in = bwd_carry
 
         def objective(sp, pp, x):
-            y = stage_fn(sp, x)
+            y = apply_stage(sp, x, idxc)
             per_mb = post_fn(pp, y, tgt)
             return jnp.where(is_last, per_mb, (y * g_in).sum())
 
@@ -697,6 +729,14 @@ class PipelineLMConfig:
     seq_len: int = 64
     learning_rate: float = 1e-3
     seed: int = 0
+    # Residual dropout on each block's attention/MLP sublayer outputs.
+    # The mask stream is keyed by (step, data shard, storage layer id,
+    # microbatch) — NOT the tensor index (row-parallel partial sums
+    # need identical masks across tensor shards, the LMTrainer rule) —
+    # and the 1F1B backward recompute replays the same keys, so its
+    # grads stay exact. Not supported on schedule='interleaved' (chunk
+    # slices carry no layer identity yet).
+    dropout_rate: float = 0.0
     # Optimizer/schedule registry (train/state.py, duck-typed on the
     # same field names as TrainConfig/LMConfig).
     optimizer: str = "adamw"  # "adamw" | "sgd" | "lion"
@@ -849,6 +889,16 @@ class PipelineLMTrainer:
                 "grad_clip_norm requires fully replicated gradients; "
                 "pipe-stage-sharded block grads are per-stage locals"
             )
+        if not 0.0 <= cfg.dropout_rate < 1.0:
+            raise ValueError(
+                f"dropout_rate must be in [0, 1), got {cfg.dropout_rate}"
+            )
+        if cfg.dropout_rate > 0.0 and cfg.schedule == "interleaved":
+            raise ValueError(
+                "dropout_rate > 0 is not supported on the interleaved "
+                "schedule (chunk slices carry no layer identity for the "
+                "mask stream); use 'gpipe' or '1f1b'"
+            )
         self.expert_parallel = bool(
             cfg.moe_expert_parallel and cfg.moe_experts > 0 and self.data_size > 1
         )
@@ -876,6 +926,7 @@ class PipelineLMTrainer:
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             rope=cfg.use_rope,
             num_kv_heads=cfg.num_kv_heads,
+            dropout_rate=cfg.dropout_rate,
         )
         # Host-init clone: no mesh axes in scope, GLOBAL kernel shapes
         # (sharded by device_put afterwards) — same recipe as
@@ -974,16 +1025,36 @@ class PipelineLMTrainer:
         )
         return put(params, self.param_specs), put(opt_state, self.opt_specs)
 
-    def _stage_fn(self):
-        """``(stacked_block_params, x) -> y``: scan the stage's local
-        block stack through the shared flax ``Block`` (optionally under
-        ``jax.checkpoint``). One compiled block body regardless of
-        depth."""
+    def _stage_fn(self, drop_base=None):
+        """``(stacked_block_params, x[, mb_idx]) -> y``: scan the
+        stage's local block stack through the shared flax ``Block``
+        (optionally under ``jax.checkpoint``). One compiled block body
+        regardless of depth.
+
+        ``drop_base`` (a per-(step, data-shard) key, or None for the
+        deterministic path) arms dropout: each block application folds
+        its GLOBAL storage layer id and the tick's microbatch index into
+        the key, so masks are unique per (layer, microbatch, step, data
+        shard), identical across tensor shards, and replayed exactly by
+        the 1F1B recompute. The returned fn then takes the extra
+        ``mb_idx`` argument (the schedules' ``pass_mb_index=True``
+        contract)."""
         cfg = self.cfg
         block = self.block
 
-        def body(bp, h):
-            return block.apply({"params": bp}, h, True)
+        if drop_base is None:
+
+            def body(bp, h):
+                return block.apply({"params": bp}, h, True)
+
+        else:
+
+            def body(bp_lid, h, mb_idx):
+                bp, lid = bp_lid
+                k = jax.random.fold_in(jax.random.fold_in(drop_base, lid), mb_idx)
+                return block.apply(
+                    {"params": bp}, h, False, rngs={"dropout": k}
+                )
 
         if cfg.remat:
             from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
@@ -993,9 +1064,21 @@ class PipelineLMTrainer:
             body = jax.checkpoint(
                 body, policy=resolve_remat_policy(cfg.remat_policy)
             )
-        return lambda stacked, x: lax.scan(
-            lambda h, bp: (body(bp, h), None), x, stacked
-        )[0]
+        if drop_base is None:
+            return lambda stacked, x: lax.scan(
+                lambda h, bp: (body(bp, h), None), x, stacked
+            )[0]
+        layers_local = cfg.num_layers // self.pipe_size
+
+        def stage(stacked, x, mb_idx):
+            lids = lax.axis_index(PIPE_AXIS) * layers_local + jnp.arange(
+                layers_local
+            )
+            return lax.scan(
+                lambda h, bl: (body(bl, h, mb_idx), None), x, (stacked, lids)
+            )[0]
+
+        return stage
 
     def _embed(self, params, tokens):
         """Token (+ absolute position unless RoPE) embedding, in compute
@@ -1022,29 +1105,33 @@ class PipelineLMTrainer:
         stage_fn = self._stage_fn()
 
         num_chunks = self.num_chunks
+        dropout = cfg.dropout_rate
+        seed = cfg.seed
 
-        def forward(params, tokens):
+        def forward(params, tokens, sfn=None, with_mb=False):
             b, t = tokens.shape
             x = self._embed(params, tokens)
             mb = x.reshape(m, b // m, t, cfg.d_model)
             if cfg.schedule == "interleaved":
                 out = spmd_pipeline_interleaved(
-                    stage_fn,
+                    sfn or stage_fn,
                     params["blocks"],
                     mb,
                     axis_name=PIPE_AXIS,
                     num_stages=s,
                     num_microbatches=m,
                     num_chunks=num_chunks,
+                    pass_mb_index=with_mb,
                 )
             else:
                 out = spmd_pipeline(
-                    stage_fn,
+                    sfn or stage_fn,
                     params["blocks"],
                     mb,
                     axis_name=PIPE_AXIS,
                     num_stages=s,
                     num_microbatches=m,
+                    pass_mb_index=with_mb,
                 )
             return self._tail(params, out.reshape(b, t, cfg.d_model))
 
@@ -1069,18 +1156,27 @@ class PipelineLMTrainer:
                 g = lax.pmean(g, TENSOR_AXIS)
             return g
 
-        def local_step_gpipe(params, tokens, targets):
+        def local_step_gpipe(params, tokens, targets, drop_base):
+            sfn = None if drop_base is None else self._stage_fn(drop_base)
+
             def loss_fn(p):
-                logits = forward(p, tokens)
+                logits = forward(
+                    p, tokens, sfn=sfn, with_mb=drop_base is not None
+                )
                 return optax.softmax_cross_entropy_with_integer_labels(
                     logits, targets
                 ).mean()
 
             return jax.value_and_grad(loss_fn)(params)
 
-        def local_step_1f1b(params, tokens, targets):
+        def local_step_1f1b(params, tokens, targets, drop_base):
             b, t = tokens.shape
             embed_keys = ("embed",) if cfg.use_rope else ("embed", "pos")
+            sfn = (
+                stage_fn
+                if drop_base is None
+                else self._stage_fn(drop_base)
+            )
 
             def embed_fn(ep):
                 x = self._embed(ep, tokens)
@@ -1100,9 +1196,10 @@ class PipelineLMTrainer:
             mb, embed_vjp = jax.vjp(embed_fn, embed_params)
             mb_tgt = targets.reshape(m, b // m, t)
             loss, d_blocks, d_post, d_mb = one_f_one_b_pipeline(
-                stage_fn, post_fn, params["blocks"], post_params,
+                sfn, post_fn, params["blocks"], post_params,
                 mb, mb_tgt,
                 axis_name=PIPE_AXIS, num_stages=s, num_microbatches=m,
+                pass_mb_index=drop_base is not None,
             )
             (d_embed,) = embed_vjp(d_mb)
             return loss, {**d_embed, "blocks": d_blocks, **d_post}
@@ -1111,8 +1208,19 @@ class PipelineLMTrainer:
             local_step_1f1b if cfg.schedule == "1f1b" else local_step_gpipe
         )
 
-        def local_step(params, opt_state, tokens, targets):
-            loss, grads = inner(params, tokens, targets)
+        def local_step(params, opt_state, tokens, targets, step):
+            # Dropout rng, LMTrainer's rule: keyed by (step, data index)
+            # — not the tensor index (row-parallel partial sums need
+            # identical masks across tensor shards), not the pipe index
+            # (the layer id folded per block already separates stages).
+            if dropout > 0.0:
+                drop_base = jax.random.fold_in(jax.random.key(seed), step)
+                drop_base = jax.random.fold_in(
+                    drop_base, lax.axis_index(DATA_AXIS)
+                )
+            else:
+                drop_base = None
+            loss, grads = inner(params, tokens, targets, drop_base)
             grads = jax.tree.map(sync_grad, grads, param_specs)
             loss = lax.pmean(loss, DATA_AXIS)
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -1120,16 +1228,28 @@ class PipelineLMTrainer:
             return params, opt_state, {"loss": loss}
 
         batch_spec = P(DATA_AXIS)
-        self.train_step = jax.jit(
+        mapped_step = jax.jit(
             jax.shard_map(
                 local_step,
                 mesh=self.mesh,
-                in_specs=(param_specs, opt_specs, batch_spec, batch_spec),
+                in_specs=(
+                    param_specs, opt_specs, batch_spec, batch_spec, P(),
+                ),
                 out_specs=(param_specs, opt_specs, {"loss": P()}),
                 check_vma=False,
             ),
             donate_argnums=(0, 1),
         )
+
+        def train_step(params, opt_state, tokens, targets, step=0):
+            """``step`` keys the dropout mask stream (ignored at
+            dropout_rate=0, so existing call sites stay valid); ``fit``
+            threads the real step index."""
+            return mapped_step(
+                params, opt_state, tokens, targets, jnp.int32(step)
+            )
+
+        self.train_step = train_step
 
         self.forward_fn = jax.jit(
             jax.shard_map(
@@ -1247,7 +1367,7 @@ class PipelineLMTrainer:
                 lo = (step * b) % max(n - b + 1, 1)
                 x, y = self.shard_batch(tokens[lo : lo + b])
                 params, opt_state, metrics = self.train_step(
-                    params, opt_state, x, y
+                    params, opt_state, x, y, step
                 )
                 losses.append(float(metrics["loss"]))
                 if (
