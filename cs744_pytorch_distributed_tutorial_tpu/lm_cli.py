@@ -193,15 +193,6 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
             raise SystemExit(
                 f"{flag} does not compose with --pipeline-parallel ({why})"
             )
-    if args.dropout_rate != 0.0 and args.pipeline_schedule == "interleaved":
-        # The interleaved chunk slices carry no layer identity for the
-        # mask stream yet; reject with the CLI's message format rather
-        # than surfacing the trainer's ValueError as a traceback.
-        raise SystemExit(
-            "--dropout-rate does not compose with --pipeline-schedule "
-            "interleaved (chunk slices carry no layer identity for the "
-            "mask stream); use gpipe or 1f1b"
-        )
     if (
         args.num_virtual_stages is not None
         and args.pipeline_schedule != "interleaved"

@@ -162,7 +162,7 @@ def spmd_pipeline_interleaved(
     num_stages: int,
     num_microbatches: int,
     num_chunks: int,
-    pass_mb_index: bool = False,  # see guard below
+    pass_mb_index: bool = False,
 ) -> jax.Array:
     """Virtual-stage pipeline: each device owns ``V = num_chunks`` model
     chunks, round-robin over the ring — virtual stage ``j = v*S + d``
@@ -190,7 +190,10 @@ def spmd_pipeline_interleaved(
     Args:
       chunk_fn: ``(chunk_params, x) -> y`` applied by every virtual
         stage; ``chunk_params`` is one chunk's slice of
-        ``stage_chunks``.
+        ``stage_chunks``. With ``pass_mb_index=True`` the signature is
+        ``(chunk_params, x, mb_idx, v)`` — the tick's microbatch index
+        AND the chunk index, because the microbatch alone would give a
+        device's V chunks identical per-microbatch rng streams.
       stage_chunks: this device's stacked chunk params — leading dim
         ``V * layers_per_vstage`` in INTERLEAVED storage order (chunk v
         occupies rows ``[v*C, (v+1)*C)``).
@@ -200,17 +203,6 @@ def spmd_pipeline_interleaved(
     Returns ``[M, ...]`` outputs of virtual stage ``V*S - 1``,
     psum-broadcast over the axis (same contract as ``spmd_pipeline``).
     """
-    if pass_mb_index:
-        # The microbatch index alone is NOT enough identity here: a
-        # device's V chunks would draw identical per-microbatch rng
-        # streams (the layer-identity hazard the trainer's rejection
-        # cites). Until (chunk, layer) ids are threaded through
-        # chunk_fn, refuse rather than ship wrong masks.
-        raise NotImplementedError(
-            "pass_mb_index on the interleaved schedule needs (chunk, "
-            "layer) identity threaded through chunk_fn; use gpipe/1f1b "
-            "for per-microbatch rng streams"
-        )
     s, m, v_chunks = num_stages, num_microbatches, num_chunks
     if mb_inputs.shape[0] != m:
         raise ValueError(
@@ -254,7 +246,14 @@ def spmd_pipeline_interleaved(
             lambda a: lax.dynamic_slice_in_dim(a, v * c, c, axis=0),
             stage_chunks,
         )
-        y = chunk_fn(chunk_params, x)
+        if pass_mb_index:
+            # The microbatch index alone is not enough identity here —
+            # a device's V chunks would draw identical rng streams —
+            # so the chunk index rides along: chunk_fn(params, x,
+            # mb_idx, v).
+            y = chunk_fn(chunk_params, x, m_idx, v)
+        else:
+            y = chunk_fn(chunk_params, x)
         write = jnp.logical_and(
             jnp.logical_and(v == v_chunks - 1, stage == s - 1),
             jnp.logical_and(r >= 0, r < v_chunks * m),
@@ -734,8 +733,11 @@ class PipelineLMConfig:
     # microbatch) — NOT the tensor index (row-parallel partial sums
     # need identical masks across tensor shards, the LMTrainer rule) —
     # and the 1F1B backward recompute replays the same keys, so its
-    # grads stay exact. Not supported on schedule='interleaved' (chunk
-    # slices carry no layer identity yet).
+    # grads stay exact. On the interleaved schedule the chunk index
+    # rides through chunk_fn so every (chunk, layer) keeps a distinct
+    # stream (masks are keyed by STORAGE layer id, which differs from
+    # the plain schedules' labeling — cross-schedule trajectories are
+    # not bit-comparable under dropout, by design).
     dropout_rate: float = 0.0
     # Optimizer/schedule registry (train/state.py, duck-typed on the
     # same field names as TrainConfig/LMConfig).
@@ -892,12 +894,6 @@ class PipelineLMTrainer:
         if not 0.0 <= cfg.dropout_rate < 1.0:
             raise ValueError(
                 f"dropout_rate must be in [0, 1), got {cfg.dropout_rate}"
-            )
-        if cfg.dropout_rate > 0.0 and cfg.schedule == "interleaved":
-            raise ValueError(
-                "dropout_rate > 0 is not supported on the interleaved "
-                "schedule (chunk slices carry no layer identity for the "
-                "mask stream); use 'gpipe' or '1f1b'"
             )
         self.expert_parallel = bool(
             cfg.moe_expert_parallel and cfg.moe_experts > 0 and self.data_size > 1
@@ -1069,6 +1065,26 @@ class PipelineLMTrainer:
                 lambda h, bp: (body(bp, h), None), x, stacked
             )[0]
         layers_local = cfg.num_layers // self.pipe_size
+
+        if cfg.schedule == "interleaved":
+            c = layers_local // self.num_chunks
+
+            def chunk(stacked, x, mb_idx, v):
+                # Storage layer ids of chunk v on this device: the
+                # device's shard starts at stage*layers_local, chunk v
+                # at offset v*c within it.
+                lids = (
+                    lax.axis_index(PIPE_AXIS) * layers_local
+                    + v * c
+                    + jnp.arange(c)
+                )
+                return lax.scan(
+                    lambda h, bl: (body(bl, h, mb_idx), None),
+                    x,
+                    (stacked, lids),
+                )[0]
+
+            return chunk
 
         def stage(stacked, x, mb_idx):
             lids = lax.axis_index(PIPE_AXIS) * layers_local + jnp.arange(

@@ -570,12 +570,78 @@ def test_pipeline_dropout_stream_properties():
     assert float(m0["loss"]) != float(m_a["loss"])  # dropout changes it
 
 
-def test_pipeline_dropout_interleaved_rejected():
-    with pytest.raises(ValueError, match="interleaved"):
-        make_trainer(
-            pipe=2, layers=8, microbatches=2, schedule="interleaved",
-            num_virtual_stages=2, dropout_rate=0.1,
+def test_pipeline_dropout_interleaved():
+    """Dropout composes with the interleaved schedule: the chunk index
+    rides through chunk_fn so each (chunk, layer) keeps a distinct mask
+    stream. Deterministic per (state, step); differs from rate 0."""
+    kw = dict(
+        data=1, pipe=2, layers=8, microbatches=2, schedule="interleaved",
+        num_virtual_stages=2,
+    )
+    tr = make_trainer(dropout_rate=0.4, **kw)
+    toks = tokens_for(tr.cfg)
+    x, y = tr.shard_batch(toks)
+    params, opt = tr.init(0)
+    _, _, m_a = tr.train_step(params, opt, x, y, step=3)
+    params2, opt2 = tr.init(0)
+    _, _, m_b = tr.train_step(params2, opt2, x, y, step=3)
+    assert float(m_a["loss"]) == float(m_b["loss"])
+
+    tr0 = make_trainer(dropout_rate=0.0, **kw)
+    p0, o0 = tr0.init(0)
+    _, _, m0 = tr0.train_step(p0, o0, x, y, step=3)
+    assert float(m0["loss"]) != float(m_a["loss"])
+
+
+def test_pipeline_dropout_chunk_identity_folded():
+    """The regression the old rejection guarded against: a device's V
+    chunks must NOT reuse one rng stream. Calls the interleaved dropout
+    chunk closure directly (pipe=1 mesh, so one device holds all
+    chunks) and asserts the chunk index v — and the microbatch index —
+    each change the masks."""
+    from jax.sharding import PartitionSpec as P
+
+    tr = make_trainer(
+        data=1, pipe=1, layers=4, microbatches=2, schedule="interleaved",
+        num_virtual_stages=2, dropout_rate=0.5,
+    )
+    chunk_fn = tr._stage_fn(jax.random.key(7))
+    params, _ = tr.init(0)
+    blocks = params["blocks"]
+    c = tr.cfg.num_layers // tr.num_chunks
+    toks = tokens_for(tr.cfg)
+    x = jnp.asarray(toks[:, :-1])
+
+    params_host = jax.device_get(params)
+    h0 = jnp.asarray(
+        params_host["embed"][np.asarray(x)]
+        + params_host["pos"][: x.shape[-1]],
+        tr._dtype,
+    )
+
+    def run(mb, v):
+        def f(bl, h):
+            chunkp = jax.tree.map(lambda a: a[:c], bl)
+            return chunk_fn(chunkp, h, jnp.int32(mb), jnp.int32(v))
+
+        return np.asarray(
+            jax.jit(
+                jax.shard_map(
+                    f,
+                    mesh=tr.mesh,
+                    in_specs=(tr.param_specs["blocks"], P()),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )(blocks, h0)
         )
+
+    out_v0 = run(0, 0)
+    out_v1 = run(0, 1)
+    out_mb1 = run(1, 0)
+    assert not np.array_equal(out_v0, out_v1), "chunk index not folded"
+    assert not np.array_equal(out_v0, out_mb1), "microbatch index not folded"
+    np.testing.assert_array_equal(out_v0, run(0, 0))  # deterministic
 
 
 def test_pipeline_evaluate_perplexity():
