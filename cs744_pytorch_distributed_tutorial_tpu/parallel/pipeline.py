@@ -380,11 +380,12 @@ def one_f_one_b_pipeline(
     applies the tail ONCE outside the schedule on the full batch. For
     large vocabularies this makes a 1F1B wave materially more expensive
     than a GPipe tick despite the equal tick *count* — pick '1f1b' for
-    its fixed-stash memory property, not for speed (a ``tensor`` mesh
-    axis divides the per-wave BLOCK recompute T ways, but the tail/head
-    stays replicated — GPipe remains the large-vocab schedule).
-    Restructuring the select cannot help — any program text present for
-    the last stage executes everywhere.
+    its fixed-stash memory property, not for speed. Mitigation: a
+    ``tensor`` mesh axis divides BOTH the per-wave block recompute and
+    the tail T ways — the trainer vocab-shards the head and computes
+    the loss via the sharded softmax (``_sharded_ce``) — shrinking the
+    gap to GPipe by 1/T. Restructuring the select cannot help — any
+    program text present for the last stage executes everywhere.
 
     Returns ``(loss, d_stage_params, d_post_params, d_mb_inputs)`` —
     loss and the d_post/d_mb trees psum-replicated over the pipe axis,
@@ -542,6 +543,43 @@ def one_f_one_b_stats(num_stages: int, num_microbatches: int) -> dict:
         "gpipe_stash_slots": m + s - 1,
         "bubble_fraction": (s - 1) / (m + s - 1),
     }
+
+
+def _sharded_ce(
+    logits_loc: jax.Array, targets: jax.Array, axis_name: str
+) -> jax.Array:
+    """Mean softmax cross-entropy over a VOCAB-SHARDED logit slice
+    ``[..., V/T]`` (column-parallel LM head), exact vs the full-vocab
+    computation:
+
+        ce = log(sum_v exp(z_v)) - z_target
+           = log(psum_T sum_local exp(z - m)) + m - psum_T masked(z_t)
+
+    ``m`` is the global row max via ``pmax`` under ``stop_gradient`` (a
+    constant stability shift — the gradient of logsumexp computed with
+    a stop-grad max is still exactly softmax). The two cross-shard sums
+    ride ``reduce_from_tp_region`` (psum forward / IDENTITY backward):
+    every device then holds the replicated loss and differentiates its
+    own local expression, so each shard's logit cotangent is exactly
+    ``softmax_local - onehot_local`` — a plain psum would deliver T
+    copies (the Megatron g-boundary rule, same as the block sublayers).
+    """
+    vloc = logits_loc.shape[-1]
+    m = lax.pmax(
+        lax.stop_gradient(logits_loc.max(axis=-1)), axis_name
+    )
+    e_sum = jnp.exp(logits_loc - m[..., None]).sum(axis=-1)
+    s = reduce_from_tp_region(e_sum, axis_name)
+    # This shard's slice of the target logit: global id -> local column.
+    local_t = targets - lax.axis_index(axis_name) * vloc
+    in_range = jnp.logical_and(local_t >= 0, local_t < vloc)
+    picked = jnp.take_along_axis(
+        logits_loc, jnp.clip(local_t, 0, vloc - 1)[..., None], axis=-1
+    )[..., 0]
+    tgt_logit = reduce_from_tp_region(
+        jnp.where(in_range, picked, 0.0), axis_name
+    )
+    return (jnp.log(s) + m - tgt_logit).mean()
 
 
 # --------------------------------------------------------------------------
@@ -886,6 +924,12 @@ class PipelineLMTrainer:
                 f"num_kv_heads {kv} not divisible by tensor axis "
                 f"{self.tensor_size}"
             )
+        if cfg.vocab_size % self.tensor_size:
+            raise ValueError(
+                f"vocab_size {cfg.vocab_size} not divisible by tensor "
+                f"axis {self.tensor_size} (the LM head is vocab-sharded "
+                "over it)"
+            )
         if cfg.grad_clip_norm is not None:
             raise ValueError(
                 "grad_clip_norm requires fully replicated gradients; "
@@ -906,6 +950,7 @@ class PipelineLMTrainer:
         self._dtype = resolve_dtype(cfg.compute_dtype)
         interpret = interpret_kernels(self.mesh)
         has_tensor = TENSOR_AXIS in self.mesh.shape and self.tensor_size > 1
+        self._has_tensor = has_tensor
         self.block = Block(
             num_heads=cfg.num_heads,
             d_ff=cfg.d_ff,
@@ -957,7 +1002,12 @@ class PipelineLMTrainer:
                 lambda s: P(PIPE_AXIS, *s), block_specs
             ),
             "ln_f_scale": P(), "ln_f_bias": P(),
-            "head": P(),
+            # Vocab-sharded head under tensor parallelism: divides the
+            # 1F1B per-wave tail cost (which lockstep SPMD pays on every
+            # stage — see one_f_one_b_pipeline) and the head memory by
+            # T; the full-vocab softmax needs only a pmax + two psums
+            # (_sharded_ce).
+            "head": P(None, TENSOR_AXIS) if has_tensor else P(),
         }
         self.tx = make_optimizer(cfg)
         self.opt_specs = optax.tree_map_params(
@@ -1106,18 +1156,34 @@ class PipelineLMTrainer:
         return x
 
     def _tail(self, params, y):
-        """Final LN + LM head -> float32 logits (TransformerLM tail)."""
+        """Final LN + LM head -> float32 logits (TransformerLM tail).
+
+        Under tensor parallelism the head kernel is vocab-sharded
+        (column-parallel): the result is this device's LOCAL
+        ``[..., V/T]`` logit slice, and the Megatron f boundary on z
+        (identity forward / psum backward) routes the residual-stream
+        cotangent's cross-shard sum. Pair with ``_ce`` for the loss."""
         z = _layer_norm(y, params["ln_f_scale"], params["ln_f_bias"])
-        return (
-            z.astype(self._dtype) @ params["head"].astype(self._dtype)
-        ).astype(jnp.float32)
+        z = z.astype(self._dtype)
+        if self._has_tensor:
+            z = copy_to_tp_region(z, TENSOR_AXIS)
+        return (z @ params["head"].astype(self._dtype)).astype(jnp.float32)
+
+    def _ce(self, logits, targets):
+        """Mean next-token CE from ``_tail`` logits — plain softmax CE,
+        or the sharded-vocab formulation under tensor parallelism."""
+        if not self._has_tensor:
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+        return _sharded_ce(logits, targets, TENSOR_AXIS)
 
     def _build_step(self) -> None:
         cfg = self.cfg
         s, m = self.pipe_size, cfg.num_microbatches
         tx = self.tx
         param_specs, opt_specs = self.param_specs, self.opt_specs
-        has_tensor = TENSOR_AXIS in self.mesh.shape and self.tensor_size > 1
+        has_tensor = self._has_tensor
         stage_fn = self._stage_fn()
 
         num_chunks = self.num_chunks
@@ -1179,9 +1245,7 @@ class PipelineLMTrainer:
                 logits = forward(
                     p, tokens, sfn=sfn, with_mb=drop_base is not None
                 )
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits, targets
-                ).mean()
+                return self._ce(logits, targets)
 
             return jax.value_and_grad(loss_fn)(params)
 
@@ -1199,9 +1263,7 @@ class PipelineLMTrainer:
                 return x.reshape(m, b // m, t, cfg.d_model)
 
             def post_fn(pp, y, tgt):
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    self._tail(pp, y), tgt
-                ).mean()
+                return self._ce(self._tail(pp, y), tgt)
 
             embed_params = {k: params[k] for k in embed_keys}
             post_params = {
@@ -1267,22 +1329,25 @@ class PipelineLMTrainer:
 
         self.train_step = train_step
 
+        # With a vocab-sharded head the forward emits LOCAL logit
+        # slices; the out-spec reassembles the global [B, T, V] array
+        # (vocab sharded over the tensor axis).
+        logits_spec = (
+            P(DATA_AXIS, None, TENSOR_AXIS) if has_tensor else batch_spec
+        )
         self.forward_fn = jax.jit(
             jax.shard_map(
                 forward,
                 mesh=self.mesh,
                 in_specs=(param_specs, batch_spec),
-                out_specs=batch_spec,
+                out_specs=logits_spec,
                 check_vma=False,
             )
         )
 
         def local_eval(params, tokens, targets):
             logits = forward(params, tokens)
-            local = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets
-            ).mean()
-            return {"loss": lax.pmean(local, DATA_AXIS)}
+            return {"loss": lax.pmean(self._ce(logits, targets), DATA_AXIS)}
 
         self.eval_step = jax.jit(
             jax.shard_map(

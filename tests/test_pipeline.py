@@ -302,6 +302,47 @@ def test_dp_pp_tp_training(mesh8):
     np.testing.assert_allclose(losses[2], losses[1], rtol=1e-5)
 
 
+def test_vocab_sharded_head_logits_and_ce(mesh8):
+    """Under tensor parallelism the LM head is vocab-sharded (the 1F1B
+    per-wave tail divider): forward_fn must still assemble the exact
+    full-vocab logits, and the sharded-vocab CE must equal optax's."""
+    import optax
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        TENSOR_AXIS,
+    )
+
+    cfg = PipelineLMConfig(
+        vocab_size=64, num_layers=4, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=64, data_parallel=2, pipeline_parallel=2,
+        tensor_parallel=2, num_microbatches=2,
+        global_batch_size=8, seq_len=16, schedule="1f1b",
+    )
+    mesh = make_mesh(
+        {DATA_AXIS: 2, PIPE_AXIS: 2, TENSOR_AXIS: 2},
+        devices=jax.devices()[:8],
+    )
+    tr = PipelineLMTrainer(cfg, mesh=mesh)
+    assert TENSOR_AXIS in tr.param_specs["head"]
+    params_global = tr._init_host(0)
+    params, _ = tr.init(0)
+    toks = tokens_for(cfg)
+    x = jnp.asarray(toks[:, :-1])
+    got = np.asarray(tr.forward_fn(params, x))  # reassembled [B, T, V]
+    want = np.asarray(tr.reference_forward(params_global, x))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+    # eval CE through _sharded_ce == full-vocab optax CE on the same
+    # logits.
+    y = jnp.asarray(toks[:, 1:])
+    ev = float(tr.eval_step(params, *tr.shard_batch(toks))["loss"])
+    ref = float(
+        optax.softmax_cross_entropy_with_integer_labels(
+            jnp.asarray(want), y
+        ).mean()
+    )
+    np.testing.assert_allclose(ev, ref, rtol=1e-5)
+
+
 def test_pipeline_rope_gqa_flash_remat_1f1b():
     """The promoted feature set composes: RoPE + GQA + flash + remat on
     the 1F1B schedule trains and matches its own gpipe twin."""
