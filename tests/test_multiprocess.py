@@ -298,3 +298,60 @@ def test_two_process_rendezvous_and_cross_process_reduction(tmp_path):
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"rank {rank} ok" in out
+
+
+_PIPELINE_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+sys.path.insert(0, {repo!r})
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import initialize
+from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+    PipelineLMConfig, PipelineLMTrainer,
+)
+
+rank = int(sys.argv[1])
+initialize({coord!r}, 2, rank)
+# One device per process -> the PIPE axis spans the process boundary:
+# every stage hop (forward ppermute, 1F1B reverse ppermute) is a real
+# cross-process transfer, the reference's multi-node p2p flow
+# (master/part2a/part2a_extra.py) doing pipeline work.
+mesh = make_mesh({{"data": 1, "pipe": 2}}, devices=jax.devices())
+cfg = PipelineLMConfig(
+    vocab_size=64, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+    max_seq_len=32, data_parallel=1, pipeline_parallel=2,
+    num_microbatches=2, global_batch_size=4, seq_len=16,
+    schedule="1f1b", seed=5,
+)
+tr = PipelineLMTrainer(cfg, mesh=mesh)
+params, opt = tr.init()
+toks = np.random.default_rng(0).integers(0, 64, (4, 17), dtype=np.int64)
+x, y = tr.shard_batch(toks)
+losses = []
+for s in range(3):
+    params, opt, m = tr.train_step(params, opt, x, y, s)
+    losses.append(round(float(m["loss"]), 8))
+assert all(np.isfinite(losses)), losses
+assert losses[-1] < losses[0], losses
+print(f"rank {{rank}} pipeline ok losses={{losses}}")
+"""
+
+
+def test_pipeline_stages_across_two_processes(tmp_path):
+    """The pipeline engine's stage hops crossing a REAL process
+    boundary: pipe=2 over two single-device processes, 1F1B schedule —
+    forward and reverse ppermutes ride the inter-process transport, and
+    both ranks observe identical losses."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _run_pair(_PIPELINE_WORKER, tmp_path, repo, "pipeline ok")
+    loss_lines = [
+        next(l for l in out.splitlines() if "losses=" in l) for out in outs
+    ]
+    assert loss_lines[0].split("losses=")[1] == loss_lines[1].split(
+        "losses="
+    )[1], loss_lines
