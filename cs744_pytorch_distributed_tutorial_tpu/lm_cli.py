@@ -18,6 +18,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import math as _math
+
+
+def _json_loss(loss):
+    """A loss value safe for json.dumps: non-finite floats become null
+    (bare NaN is invalid JSON; the 'finite' key carries the signal)."""
+    return loss if loss is not None and _math.isfinite(loss) else None
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -97,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout-rate", type=float, default=0.0,
                    help="residual dropout on each block's sublayer "
                         "outputs; masks are keyed by the step index")
+    p.add_argument("--no-halt-on-nonfinite", dest="halt_on_nonfinite",
+                   action="store_false", default=True,
+                   help="keep training through NaN/inf losses (and emit "
+                        "'finite': false in --json) instead of raising "
+                        "NonFiniteLossError")
     p.add_argument("--accum-steps", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
@@ -254,6 +266,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         weight_decay=args.weight_decay,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        halt_on_nonfinite=args.halt_on_nonfinite,
     )
     trainer = PipelineLMTrainer(cfg)
     eval_tokens, tokens = _split_eval(
@@ -274,7 +287,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
                     "data_parallel": cfg.data_parallel,
                     "tensor_parallel": cfg.tensor_parallel,
                     "num_microbatches": cfg.num_microbatches,
-                    "final_loss": losses[-1] if losses else None,
+                    "final_loss": _json_loss(losses[-1]) if losses else None,
                     # null when the run executed zero steps (checkpoint
                     # already at --steps) — a gating script must not
                     # read a no-op resume as a healthy training signal.
@@ -362,6 +375,7 @@ def main(argv: list[str] | None = None) -> int:
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        halt_on_nonfinite=args.halt_on_nonfinite,
     )
     eval_tokens, tokens = _split_eval(
         args.eval_frac, tokens, cfg.global_batch_size
@@ -437,8 +451,15 @@ def main(argv: list[str] | None = None) -> int:
                         "tensor": args.tensor_parallel,
                     },
                     "steps": args.steps,
-                    "first_loss": losses[0] if losses else None,
-                    "final_loss": losses[-1] if losses else None,
+                    # Non-finite floats would make the document invalid
+                    # JSON (json.dumps emits bare NaN) — null them and
+                    # let "finite" carry the divergence signal.
+                    "first_loss": _json_loss(losses[0]) if losses else None,
+                    "final_loss": _json_loss(losses[-1]) if losses else None,
+                    "finite": (
+                        bool(_math.isfinite(losses[-1])) if losses else None
+                    ),
+                    "steps_run": len(losses),
                     "eval": eval_metrics,
                     "sample": sample_text or sample_ids,
                 }

@@ -32,6 +32,7 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any
 
 import flax.struct
@@ -795,6 +796,11 @@ class PipelineLMConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end when dir set
 
+    # Failure detection (utils/failure.py), the same contract as the
+    # CIFAR and LM engines: NaN/inf losses raise NonFiniteLossError
+    # (fit() fetches every loss anyway — zero extra transfers).
+    halt_on_nonfinite: bool = True
+
     def replace(self, **kw: Any) -> "PipelineLMConfig":
         return dataclasses.replace(self, **kw)
 
@@ -1403,8 +1409,18 @@ class PipelineLMTrainer:
         ``cfg.checkpoint_dir`` set, resumes exactly from the newest
         checkpoint (the batch at step k is a pure function of k), saving
         every ``checkpoint_every`` steps and at the end — the same
-        resume contract as ``LMTrainer.fit``."""
+        resume contract as ``LMTrainer.fit``. With
+        ``cfg.halt_on_nonfinite`` (default), a NaN/inf loss raises
+        ``NonFiniteLossError`` instead of training on garbage, and
+        checkpoints are persisted only after a LATER forward pass over
+        their params comes back finite (the CIFAR engine's
+        divergence-safe ordering: restart recovery can never restore a
+        state whose own forward diverged)."""
         cfg = self.cfg
+        if cfg.halt_on_nonfinite:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+                NonFiniteLossError,
+            )
         params, opt_state = self.init()
         start_step = 0
         ckpt = None
@@ -1443,6 +1459,14 @@ class PipelineLMTrainer:
                 params, opt_state = restored.params, restored.opt_state
         losses: list[float] = []
         n, b = len(tokens), cfg.global_batch_size
+        # Divergence-safe checkpointing (the CIFAR engine's ordering):
+        # the loss fetched at step k is the forward over the params the
+        # PREVIOUS update produced, so a due checkpoint is held and
+        # persisted only once a later finite loss certifies its params.
+        # KEEP IN SYNC with the siblings in train/engine.py and
+        # train/lm.py::fit.
+        pending_ckpt = None
+        x = y = None
         try:
             for step in range(start_step, steps):
                 lo = (step * b) % max(n - b + 1, 1)
@@ -1450,17 +1474,43 @@ class PipelineLMTrainer:
                 params, opt_state, metrics = self.train_step(
                     params, opt_state, x, y, step
                 )
-                losses.append(float(metrics["loss"]))
+                loss = float(metrics["loss"])
+                if cfg.halt_on_nonfinite and not math.isfinite(loss):
+                    raise NonFiniteLossError(step, loss)
+                if pending_ckpt is not None:
+                    # This finite loss ran over pending_ckpt's params.
+                    ckpt.save(pending_ckpt)
+                    pending_ckpt = None
+                losses.append(loss)
                 if (
                     ckpt
                     and cfg.checkpoint_every
                     and (step + 1) % cfg.checkpoint_every == 0
                 ):
-                    ckpt.save(
-                        self._make_state(step + 1, params, opt_state)
-                    )
+                    if cfg.halt_on_nonfinite:
+                        # Copy: train_step donates its input state, so
+                        # holding the live arrays across the next step
+                        # would reference deleted buffers (same as the
+                        # CIFAR engine's pending copy).
+                        pending_ckpt = self._make_state(
+                            step + 1,
+                            jax.tree.map(jnp.copy, params),
+                            jax.tree.map(jnp.copy, opt_state),
+                        )
+                    else:
+                        ckpt.save(
+                            self._make_state(step + 1, params, opt_state)
+                        )
             if ckpt is not None:
                 final = max(steps, start_step)
+                if cfg.halt_on_nonfinite and steps > start_step:
+                    # Certify the final params with one eval forward
+                    # before persisting (no later train step will).
+                    f_loss = float(self.eval_step(params, x, y)["loss"])
+                    if not math.isfinite(f_loss):
+                        raise NonFiniteLossError(steps, f_loss)
+                # The final save supersedes any still-pending
+                # intermediate state (same params lineage, later step).
                 ckpt.save(
                     self._make_state(final, params, opt_state),
                     force=True,

@@ -692,6 +692,16 @@ class LMTrainer:
                 jax.profiler.stop_trace()
                 profiling_active = False
 
+        # Divergence-safe checkpointing (the CIFAR engine's ordering,
+        # train/engine.py): the loss fetched at step k is the forward
+        # over the params the PREVIOUS update produced, so a due
+        # checkpoint is held and persisted only once a later finite
+        # loss certifies its params — restart recovery can never
+        # restore a state whose own forward diverged. KEEP IN SYNC with
+        # the sibling implementations in train/engine.py (epoch loop,
+        # watchdog-guarded saves) and parallel/pipeline.py::fit.
+        pending_ckpt = None
+        x = y = None
         try:
             for step in range(start_step, steps):
                 lo = (step * b) % max(n - b + 1, 1)
@@ -725,15 +735,38 @@ class LMTrainer:
                     stop_profile()
                 if cfg.halt_on_nonfinite and not math.isfinite(loss):
                     raise NonFiniteLossError(step, loss)
+                if pending_ckpt is not None:
+                    # This finite loss ran over pending_ckpt's params.
+                    ckpt.save(pending_ckpt)
+                    pending_ckpt = None
                 losses.append(loss)
                 if (
                     ckpt
                     and cfg.checkpoint_every
                     and (step + 1) % cfg.checkpoint_every == 0
                 ):
-                    ckpt.save(LMState(jnp.int32(step + 1), params, opt_state))
+                    if cfg.halt_on_nonfinite:
+                        # Copy: train_step donates its input state, so
+                        # holding the live arrays across the next step
+                        # would reference deleted buffers (same as the
+                        # CIFAR engine's pending copy).
+                        pending_ckpt = LMState(
+                            jnp.int32(step + 1),
+                            jax.tree.map(jnp.copy, params),
+                            jax.tree.map(jnp.copy, opt_state),
+                        )
+                    else:
+                        ckpt.save(
+                            LMState(jnp.int32(step + 1), params, opt_state)
+                        )
             if ckpt is not None:
                 final = max(steps, start_step)
+                if cfg.halt_on_nonfinite and steps > start_step:
+                    # Certify the final params with one eval forward
+                    # before persisting (no later train step will).
+                    f_loss = float(self.eval_step(params, x, y)["loss"])
+                    if not math.isfinite(f_loss):
+                        raise NonFiniteLossError(steps, f_loss)
                 ckpt.save(
                     LMState(jnp.int32(final), params, opt_state), force=True
                 )
