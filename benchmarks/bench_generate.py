@@ -34,7 +34,22 @@ NEW = 256
 REPEATS = 5
 
 
+def _time_gen(generate, params, prompt) -> float:
+    out = generate(params, prompt, jax.random.key(2))  # compile
+    float(out[0, 0])
+    for _ in range(4):  # steady-state warm-up (see bench_lm.py)
+        out = generate(params, prompt, jax.random.key(2))
+    float(out[0, 0])
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = generate(params, prompt, jax.random.key(2))
+    float(out[0, 0])  # value fetch fences (see bench.py)
+    return (time.perf_counter() - t0) / REPEATS
+
+
 def main() -> None:
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import quantize_lm_params
+
     prompt = jax.random.randint(jax.random.key(0), (BATCH, PROMPT), 0, 32768)
     for kv in (8, 2, 1):
         model = TransformerLM(
@@ -53,21 +68,25 @@ def main() -> None:
             jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
         )["params"]
         generate = make_generator(model, max_new_tokens=NEW, temperature=0.0)
-
-        out = generate(params, prompt, jax.random.key(2))  # compile
-        float(out[0, 0])
-        for _ in range(4):  # steady-state warm-up (see bench_lm.py)
-            out = generate(params, prompt, jax.random.key(2))
-        float(out[0, 0])
-        t0 = time.perf_counter()
-        for _ in range(REPEATS):
-            out = generate(params, prompt, jax.random.key(2))
-        float(out[0, 0])  # value fetch fences (see bench.py)
-        dt = (time.perf_counter() - t0) / REPEATS
+        dt = _time_gen(generate, params, prompt)
         print(
-            f"kv_heads={kv}  {dt * 1e3:8.1f} ms/gen  "
+            f"kv_heads={kv}             {dt * 1e3:8.1f} ms/gen  "
             f"{BATCH * NEW / dt:10.0f} tokens/sec"
         )
+        if kv == 2:
+            # Weight-only int8 ablation on the GQA winner: same model,
+            # kernels stored int8 + per-channel scale, dequant inside
+            # the Pallas matmul (ops/quant.py).
+            qgen = make_generator(
+                model.clone(quant_dense=True), max_new_tokens=NEW,
+                temperature=0.0,
+            )
+            qdt = _time_gen(qgen, quantize_lm_params(params), prompt)
+            print(
+                f"kv_heads={kv} int8 dense  {qdt * 1e3:8.1f} ms/gen  "
+                f"{BATCH * NEW / qdt:10.0f} tokens/sec  "
+                f"({dt / qdt:.2f}x vs bf16)"
+            )
 
 
 if __name__ == "__main__":

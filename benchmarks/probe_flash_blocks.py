@@ -1,0 +1,73 @@
+"""Flash-attention block-size probe at short sequence lengths.
+
+The kernel defaults (block_q=512, block_k=1024) were tuned at T=2048
+(`ops/flash_attention.py`). At T=1024 (the GPT-2 bench point) block_k
+covers the WHOLE sequence, so the causal prune degenerates: the qi=0
+row-block multiplies against all 1024 keys with half of them masked —
+~25% of the forward MXU work is dead vs a (512, 512) tiling that stops
+at the diagonal. This probes fwd and fwd+bwd wall-clock across block
+choices at the GPT-2 attention shape to decide whether a per-T default
+is worth carrying.
+
+Run: python benchmarks/probe_flash_blocks.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
+    flash_attention,
+)
+
+B, H, D = 8, 12, 64
+REPEATS = 30
+
+
+def bench(fn, *args) -> float:
+    out = fn(*args)
+    jax.tree.leaves(out)[0].block_until_ready()
+    float(jax.tree.leaves(out)[0].ravel()[0])  # fence (see bench.py)
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        out = fn(*args)
+    float(jax.tree.leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) / REPEATS * 1e3
+
+
+def main() -> None:
+    for t in (1024, 2048):
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(k1, (B, t, H, D), jnp.bfloat16)
+        k = jax.random.normal(k2, (B, t, H, D), jnp.bfloat16)
+        v = jax.random.normal(k3, (B, t, H, D), jnp.bfloat16)
+        print(f"T={t}  [B={B}, H={H}, D={D}] bf16 causal")
+        for bq, bk in ((512, 1024), (512, 512), (256, 512), (512, 256), (256, 256), (1024, 512)):
+            if bq > t or bk > t:
+                continue
+            fwd = jax.jit(
+                partial(flash_attention, causal=True, block_q=bq, block_k=bk)
+            )
+
+            def loss(q, k, v, f=fwd):
+                return f(q, k, v).astype(jnp.float32).sum()
+
+            grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+            ms_f = bench(fwd, q, k, v)
+            ms_g = bench(grad, q, k, v)
+            print(
+                f"  block_q={bq:5d} block_k={bk:5d}  fwd {ms_f:7.2f} ms   "
+                f"fwd+bwd {ms_g:7.2f} ms"
+            )
+
+
+if __name__ == "__main__":
+    main()

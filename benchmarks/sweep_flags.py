@@ -1,0 +1,154 @@
+"""XLA:TPU compiler-option sweep for the scored ResNet-18 step.
+
+Round 2 found one compile-option win (``xla_tpu_scoped_vmem_limit_kib=
+65536``, ~7%); round 3 closed the custom-kernel route with measurements
+(``ablate.py``), leaving compiler-generation settings as the remaining
+scored-bench lever. This script probes candidate options one at a time
+against the current baseline configuration: unknown options are reported
+as unavailable (the compile raises), available ones get a measured
+steps/sec. Short windows — this ranks candidates; anything that wins
+here gets promoted to ``bench.py`` and re-measured at the full window.
+
+Run: python benchmarks/sweep_flags.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+BATCH = 1024
+WARMUP = 8
+STEPS = 40
+
+BASE = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+
+# Candidates: each is (name, value) merged over BASE; None value means
+# "drop the key from BASE" (measures the flag's own contribution).
+CANDIDATES: list[tuple[str, dict]] = [
+    ("baseline (r2 options)", {}),
+    ("no scoped-vmem raise", {"xla_tpu_scoped_vmem_limit_kib": None}),
+    ("vmem 98304", {"xla_tpu_scoped_vmem_limit_kib": "98304"}),
+    ("vmem 131072", {"xla_tpu_scoped_vmem_limit_kib": "131072"}),
+    (
+        "aggressive loop fusion layout",
+        {"xla_tpu_enable_aggressive_loop_fusion_layout_opt": "true"},
+    ),
+    ("dot-dot fusion", {"xla_tpu_dot_dot_fusion": "true"}),
+    ("rwb fusion off", {"xla_tpu_rwb_fusion": "false"}),
+    (
+        "licm inflation 2x",
+        {"xla_tpu_licm_size_inflation_ratio": "2.0"},
+    ),
+    (
+        "vector load fusion",
+        {"xla_tpu_vector_load_fusion_window": "1024"},
+    ),
+    (
+        "multi-level nested fusion",
+        {"xla_tpu_enable_multi_level_nested_loop_fusion": "true"},
+    ),
+    (
+        "combo: nested+rwb-off",
+        {
+            "xla_tpu_enable_multi_level_nested_loop_fusion": "true",
+            "xla_tpu_rwb_fusion": "false",
+        },
+    ),
+    (
+        "combo: nested+rwb-off+agg-layout",
+        {
+            "xla_tpu_enable_multi_level_nested_loop_fusion": "true",
+            "xla_tpu_rwb_fusion": "false",
+            "xla_tpu_enable_aggressive_loop_fusion_layout_opt": "true",
+        },
+    ),
+    (
+        "combo: all four",
+        {
+            "xla_tpu_enable_multi_level_nested_loop_fusion": "true",
+            "xla_tpu_rwb_fusion": "false",
+            "xla_tpu_enable_aggressive_loop_fusion_layout_opt": "true",
+            "xla_tpu_vector_load_fusion_window": "1024",
+        },
+    ),
+]
+
+
+def build():
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    n = len(jax.devices())
+    cfg = TrainConfig(
+        model="resnet18",
+        sync="auto",
+        num_devices=n,
+        global_batch_size=BATCH,
+        compute_dtype="bfloat16",
+        synthetic_data=True,
+    )
+    mesh = make_mesh({"data": n})
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init()
+    ds = synthetic_cifar10(BATCH, 16, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    return trainer, state, x, y, jax.random.key(cfg.seed)
+
+
+def measure(trainer, state, x, y, key, options) -> float:
+    fn = trainer.train_step.lower(state, x, y, key).compile(
+        compiler_options=options
+    )
+
+    def fence(s):
+        float(jax.tree.leaves(s.params)[0].ravel()[0])
+
+    for _ in range(WARMUP):
+        state, _ = fn(state, x, y, key)
+    fence(state)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, _ = fn(state, x, y, key)
+    fence(state)
+    return STEPS * BATCH / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    trainer, state0, x, y, key = build()
+    results = []
+    for name, delta in CANDIDATES:
+        options = dict(BASE)
+        for k, v in delta.items():
+            if v is None:
+                options.pop(k, None)
+            else:
+                options[k] = v
+        # Donated input: re-init per candidate so every run sees live
+        # buffers.
+        state = trainer.init()
+        try:
+            sps = measure(trainer, state, x, y, key, options)
+        except Exception as e:  # unknown flag / compile failure
+            print(f"{name:36s}  UNAVAILABLE ({type(e).__name__}: {str(e)[:90]})")
+            continue
+        results.append((sps, name))
+        print(f"{name:36s}  {sps:10.1f} samples/sec")
+    results.sort(reverse=True)
+    print("\nranked:")
+    for sps, name in results:
+        print(f"  {sps:10.1f}  {name}")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--int8-decode", action="store_true",
+                   help="generate with weight-only int8 projections "
+                        "(ops/quant.py): kernels stored int8 + per-channel "
+                        "scale, dequantized inside the Pallas matmul — "
+                        "halves decode's weight-read bandwidth")
     p.add_argument("--beam", type=int, default=0, metavar="K",
                    help="beam-search decode with K beams instead of sampling")
     p.add_argument("--json", action="store_true")
@@ -413,20 +418,25 @@ def main(argv: list[str] | None = None) -> int:
             prompt_ids = tokens[:1, : args.prompt_len]
         host_params = jax.device_get(params)
         prompt_arr = np.asarray(prompt_ids, dtype=np.int32)
+        if args.int8_decode:
+            decode_model = trainer.quantized_decode_model()
+            host_params = trainer.quantize_for_decode(host_params)
+        else:
+            decode_model = trainer.decode_model()
         if args.beam > 0:
             from cs744_pytorch_distributed_tutorial_tpu.infer import (
                 make_beam_searcher,
             )
 
             search = make_beam_searcher(
-                trainer.decode_model(),
+                decode_model,
                 beam_size=args.beam,
                 max_new_tokens=args.generate,
             )
             out, _ = search(host_params, prompt_arr)
         else:
             generate = make_generator(
-                trainer.decode_model(),
+                decode_model,
                 max_new_tokens=args.generate,
                 temperature=args.temperature,
                 top_k=args.top_k,

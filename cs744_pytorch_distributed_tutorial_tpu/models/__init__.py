@@ -33,6 +33,10 @@ from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
     vgg16,
     vgg19,
 )
+from cs744_pytorch_distributed_tutorial_tpu.models.torch_interop import (
+    torch_state_dict_from_vgg_variables,
+    vgg_variables_from_torch_state_dict,
+)
 from cs744_pytorch_distributed_tutorial_tpu.models.vit import (
     ViT,
     vit_small,
@@ -113,6 +117,8 @@ __all__ = [
     "resnet34",
     "resnet50",
     "tiny_cnn",
+    "torch_state_dict_from_vgg_variables",
+    "vgg_variables_from_torch_state_dict",
     "vgg11",
     "vgg13",
     "vgg16",

@@ -1,0 +1,125 @@
+"""Torch checkpoint interop for the VGG family — the switching path.
+
+A user of the reference has torch checkpoints of its ``_VGG`` model
+(``master/part1/model.py:30-46``: a ``layers`` Sequential of
+Conv2d/BatchNorm2d/ReLU/MaxPool2d plus an ``fc1`` Linear). These
+converters map that ``state_dict`` to/from this framework's flax ``VGG``
+trees (``models/vgg.py``) so trained weights move across frameworks in
+either direction:
+
+- conv kernels transpose OIHW (torch) <-> HWIO (flax NHWC convs);
+- BatchNorm ``weight``/``bias`` <-> ``scale``/``bias`` params, and
+  ``running_mean``/``running_var`` <-> ``batch_stats`` collections
+  (``num_batches_tracked`` has no flax counterpart and is dropped /
+  regenerated as 0);
+- the ``fc1`` Linear weight transposes [out, in] <-> [in, out].
+
+The 32x32 pipeline flattens a 1x1x512 feature map, so the NCHW-vs-NHWC
+flatten-order question is moot for the reference's input size; for other
+spatial sizes the head would need a permutation this module deliberately
+refuses to guess (it asserts the 512-feature head).
+
+No hard torch dependency: tensors are accepted as anything
+``np.asarray`` understands, with ``.detach().cpu()`` applied first when
+present, and the export side emits plain numpy arrays (feed through
+``torch.from_numpy`` as needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.models.vgg import VGG_CFGS
+
+
+def _np(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor, no torch import needed
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _seq_indices(cfg: Sequence[Any]):
+    """Yield (flax_index, torch_sequential_index) per conv block, walking
+    the reference's ``_make_layers`` layout (conv, bn, relu per entry;
+    one maxpool per 'M' — ``master/part1/model.py:11-27``)."""
+    ti = 0
+    fi = 0
+    for entry in cfg:
+        if entry == "M":
+            ti += 1
+        else:
+            yield fi, ti
+            fi += 1
+            ti += 3
+
+
+def vgg_variables_from_torch_state_dict(
+    state_dict: Mapping[str, Any], arch: str = "vgg11"
+) -> dict:
+    """Convert a reference ``_VGG`` ``state_dict`` into flax variable
+    collections: ``{"params": ..., "batch_stats": ...}`` ready for
+    ``VGG(...).apply(variables, x)`` or to seed this framework's
+    ``Trainer``. ``arch`` picks the layer table (the reference exports
+    only VGG11; all four tables are supported)."""
+    if arch not in VGG_CFGS:
+        raise ValueError(f"unknown arch {arch!r}; choose from {sorted(VGG_CFGS)}")
+    params: dict = {}
+    stats: dict = {}
+    for fi, ti in _seq_indices(VGG_CFGS[arch]):
+        w = _np(state_dict[f"layers.{ti}.weight"])
+        params[f"Conv_{fi}"] = {
+            "kernel": w.transpose(2, 3, 1, 0),  # OIHW -> HWIO
+            "bias": _np(state_dict[f"layers.{ti}.bias"]),
+        }
+        params[f"BatchNorm_{fi}"] = {
+            "scale": _np(state_dict[f"layers.{ti + 1}.weight"]),
+            "bias": _np(state_dict[f"layers.{ti + 1}.bias"]),
+        }
+        stats[f"BatchNorm_{fi}"] = {
+            "mean": _np(state_dict[f"layers.{ti + 1}.running_mean"]),
+            "var": _np(state_dict[f"layers.{ti + 1}.running_var"]),
+        }
+    fc_w = _np(state_dict["fc1.weight"])
+    if fc_w.shape[1] != 512:
+        raise ValueError(
+            f"fc1 expects the 512-feature head of the 32x32 pipeline, got "
+            f"in-features {fc_w.shape[1]} — flatten-order conversion for "
+            "other spatial sizes is deliberately unsupported"
+        )
+    params["Dense_0"] = {"kernel": fc_w.T, "bias": _np(state_dict["fc1.bias"])}
+    return {"params": params, "batch_stats": stats}
+
+
+def torch_state_dict_from_vgg_variables(
+    variables: Mapping[str, Any], arch: str = "vgg11"
+) -> dict:
+    """The reverse: flax ``{"params", "batch_stats"}`` -> a dict keyed
+    exactly like the reference ``_VGG.state_dict()`` (numpy values;
+    ``num_batches_tracked`` emitted as 0)."""
+    if arch not in VGG_CFGS:
+        raise ValueError(f"unknown arch {arch!r}; choose from {sorted(VGG_CFGS)}")
+    params = variables["params"]
+    stats = variables.get("batch_stats", {})
+    out: dict = {}
+    for fi, ti in _seq_indices(VGG_CFGS[arch]):
+        conv = params[f"Conv_{fi}"]
+        out[f"layers.{ti}.weight"] = _np(conv["kernel"]).transpose(3, 2, 0, 1)
+        out[f"layers.{ti}.bias"] = _np(conv["bias"])
+        bn = params[f"BatchNorm_{fi}"]
+        out[f"layers.{ti + 1}.weight"] = _np(bn["scale"])
+        out[f"layers.{ti + 1}.bias"] = _np(bn["bias"])
+        bs = stats.get(f"BatchNorm_{fi}", {})
+        n = _np(bn["scale"]).shape[0]
+        out[f"layers.{ti + 1}.running_mean"] = _np(
+            bs.get("mean", np.zeros(n, np.float32))
+        )
+        out[f"layers.{ti + 1}.running_var"] = _np(
+            bs.get("var", np.ones(n, np.float32))
+        )
+        out[f"layers.{ti + 1}.num_batches_tracked"] = np.asarray(0, np.int64)
+    head = params["Dense_0"]
+    out["fc1.weight"] = _np(head["kernel"]).T
+    out["fc1.bias"] = _np(head["bias"])
+    return out

@@ -46,6 +46,16 @@ ATTENTION_IMPLS = (
 REMAT_POLICIES = ("none", "dots")
 
 
+def _dense_cls(quant: bool):
+    """``nn.Dense``, or the weight-only-int8 ``QuantDense`` under
+    ``quant_dense=True`` (lazy import — the quant path is decode-only)."""
+    if not quant:
+        return nn.Dense
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import QuantDense
+
+    return QuantDense
+
+
 def apply_rope(
     x: jnp.ndarray, positions: jnp.ndarray, base: float = 10000.0
 ) -> jnp.ndarray:
@@ -92,9 +102,11 @@ def default_flash_interpret() -> bool:
     global default backend — when the computation targets a non-default
     device set (e.g. a CPU test mesh on a TPU host), set the module's
     ``flash_interpret`` field from the mesh instead (as LMTrainer does)."""
-    import jax
+    from cs744_pytorch_distributed_tutorial_tpu.ops._backend import (
+        default_interpret,
+    )
 
-    return jax.default_backend() not in ("tpu", "axon")
+    return default_interpret()
 
 
 class Attention(nn.Module):
@@ -131,6 +143,9 @@ class Attention(nn.Module):
     # the decode-memory/bandwidth lever — and K/V repeat up to the query
     # head count at compute time. None = standard MHA.
     num_kv_heads: int | None = None
+    # Weight-only int8 projections (ops/quant.py::QuantDense) — the
+    # decode-bandwidth lever; params come from quantize_lm_params.
+    quant_dense: bool = False
 
     @nn.compact
     def __call__(
@@ -179,7 +194,9 @@ class Attention(nn.Module):
         kv_local = kv_heads // self.tensor_axis_size if tp else kv_heads
         if tp:
             x = copy_to_tp_region(x, self.tensor_axis)
-        proj = partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        proj = partial(
+            _dense_cls(self.quant_dense), use_bias=False, dtype=self.dtype
+        )
         q = proj(heads_local * head_dim, name="q")(x)
         k = proj(kv_local * head_dim, name="k")(x)
         v = proj(kv_local * head_dim, name="v")(x)
@@ -303,9 +320,9 @@ class Attention(nn.Module):
                 "'ulysses', or 'ulysses_flash', or set seq_axis=None"
             )
         out = out.reshape(b, t, heads_local * head_dim).astype(self.dtype)
-        out = nn.Dense(d_model, use_bias=False, dtype=self.dtype, name="attn_out")(
-            out
-        )
+        out = _dense_cls(self.quant_dense)(
+            d_model, use_bias=False, dtype=self.dtype, name="attn_out"
+        )(out)
         if tp:
             out = reduce_from_tp_region(out, self.tensor_axis)
         return out
@@ -337,6 +354,7 @@ class Block(nn.Module):
     # only when the CALLER passes deterministic=False (and supplies a
     # 'dropout' rng); rate 0.0 is a no-op either way.
     dropout_rate: float = 0.0
+    quant_dense: bool = False
 
     @nn.compact
     def __call__(
@@ -379,6 +397,7 @@ class Block(nn.Module):
             rope=self.rope,
             rope_base=self.rope_base,
             num_kv_heads=self.num_kv_heads,
+            quant_dense=self.quant_dense,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
         if self.dropout_rate > 0.0:
@@ -408,11 +427,13 @@ class Block(nn.Module):
         # Column-parallel in, row-parallel out; the out bias is a separate
         # parameter applied AFTER the tp psum (a row-parallel Dense's own
         # bias would be summed tensor_axis_size times).
-        h = nn.Dense(d_ff_local, dtype=self.dtype, name="mlp_in")(h)
+        h = _dense_cls(self.quant_dense)(
+            d_ff_local, dtype=self.dtype, name="mlp_in"
+        )(h)
         h = nn.gelu(h)
-        h = nn.Dense(x.shape[-1], use_bias=False, dtype=self.dtype, name="mlp_out")(
-            h
-        )
+        h = _dense_cls(self.quant_dense)(
+            x.shape[-1], use_bias=False, dtype=self.dtype, name="mlp_out"
+        )(h)
         if self.dropout_rate > 0.0:
             h = drop(name="mlp_drop")(h)
         if tp:
@@ -479,6 +500,10 @@ class TransformerLM(nn.Module):
     # folds must not vary along it — train/lm.py derives it from
     # (step, data index, seq index) only.
     dropout_rate: float = 0.0
+    # Weight-only int8 Dense kernels (ops/quant.py) — the decode
+    # bandwidth lever. Pair with params from ``quantize_lm_params``;
+    # see ``LMTrainer.quantized_decode_model``.
+    quant_dense: bool = False
 
     @nn.compact
     def __call__(
@@ -545,6 +570,7 @@ class TransformerLM(nn.Module):
                 rope_base=self.rope_base,
                 num_kv_heads=self.num_kv_heads,
                 dropout_rate=self.dropout_rate,
+                quant_dense=self.quant_dense,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults
@@ -557,9 +583,11 @@ class TransformerLM(nn.Module):
                 x = block(x, mode=mode, decode_pos=decode_pos)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         if self.tie_embeddings:
+            # The attend path reuses the (unquantized) embedding table —
+            # quant_dense deliberately leaves it float.
             logits = tok_embed.attend(x)
         else:
-            logits = nn.Dense(
+            logits = _dense_cls(self.quant_dense)(
                 self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
             )(x)
         return logits.astype(jnp.float32)

@@ -16,10 +16,20 @@ from cs744_pytorch_distributed_tutorial_tpu.ops.fused_conv import (  # noqa: F40
 from cs744_pytorch_distributed_tutorial_tpu.ops.fused_xent import (  # noqa: F401
     fused_cross_entropy,
 )
+from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (  # noqa: F401
+    QuantDense,
+    int8_matmul,
+    quantize_int8,
+    quantize_lm_params,
+)
 
 __all__ = [
     "flash_attention",
     "conv3x3",
     "conv3x3_wgrad",
     "fused_cross_entropy",
+    "QuantDense",
+    "int8_matmul",
+    "quantize_int8",
+    "quantize_lm_params",
 ]

@@ -1,0 +1,22 @@
+"""One home for the "is this a TPU backend?" probe the kernel layer
+shares. Pallas kernels Mosaic-compile only on TPU platforms — 'tpu'
+proper and this environment's 'axon' tunnel plugin — and run in
+interpret mode everywhere else. Keeping the platform set here means a
+future TPU-like platform string is added once, not once per kernel
+(``models/transformer.py::default_flash_interpret`` and
+``parallel/mesh.py::interpret_kernels`` both resolve against this set).
+"""
+
+from __future__ import annotations
+
+import jax
+
+TPU_PLATFORMS = frozenset({"tpu", "axon"})
+
+
+def default_interpret() -> bool:
+    """Interpret kernels when the GLOBAL default backend is not a TPU.
+    For computations targeting a non-default device set (a CPU test mesh
+    on a TPU host), decide from the mesh instead —
+    ``parallel/mesh.py::interpret_kernels``."""
+    return jax.default_backend() not in TPU_PLATFORMS

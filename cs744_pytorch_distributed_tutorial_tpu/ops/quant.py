@@ -1,0 +1,242 @@
+"""Weight-only int8 quantization for the decode path (Pallas kernel).
+
+No counterpart exists in the reference (it never runs inference beyond a
+float eval loop, ``master/part1/part1.py:47-62``) — this is a
+TPU-native *inference* capability: autoregressive decoding is bound by
+HBM bandwidth (every step re-reads all projection weights plus the KV
+cache), so storing the Dense kernels as int8 with a per-output-channel
+float scale halves the weight traffic vs bfloat16.
+
+Why a Pallas kernel instead of ``x @ (q * scale)`` in XLA: the decode
+loop is a ``lax.scan`` whose weights are loop-invariant, so XLA hoists
+any out-of-matmul dequantization above the loop — the program then reads
+*bfloat16* weights every step and the bandwidth win evaporates (it only
+pays the dequant once, which was never the expensive part). The kernel
+dequantizes INSIDE the matmul tile loop: int8 tiles stream from HBM into
+VMEM, widen to the activation dtype in registers, hit the MXU, and the
+per-channel scale is applied to the f32 accumulator after the dot (for a
+per-OUTPUT-channel scale the two orderings are algebraically identical).
+
+Quantization scheme: symmetric per-output-channel —
+``q = round(w / s)`` with ``s = max|w| / 127`` per column, clipped to
+[-127, 127] (the -128 code is unused, keeping the scheme symmetric).
+Only matmul kernels quantize; biases, embeddings, and layernorms stay in
+float (they are a rounding error of the weight bytes).
+
+``QuantDense`` is the drop-in flax module (same call surface as
+``nn.Dense``) that ``models/transformer.py`` swaps in under
+``quant_dense=True``; ``quantize_lm_params`` converts a trained
+``TransformerLM`` param tree into the matching quantized tree.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled builds; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+
+def quantize_int8(w: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of a ``[K, N]``
+    kernel: returns ``(q int8 [K, N], scale f32 [N])`` with
+    ``q * scale ~= w``. All-zero columns get scale 1 (and stay zero)."""
+    if w.ndim != 2:
+        raise ValueError(f"quantize_int8 expects a [K, N] kernel, got {w.shape}")
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale[None, :]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_matmul_ref(x: jax.Array, q: jax.Array, scale: jax.Array) -> jax.Array:
+    """XLA reference semantics of the kernel: widen-to-activation-dtype
+    matmul with f32 accumulation, then the per-channel scale. Used as the
+    fallback for shapes the kernel does not tile and as the test oracle
+    (the kernel must match it exactly up to dot reassociation)."""
+    acc = jax.lax.dot_general(
+        x,
+        q.astype(x.dtype),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (acc * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref):
+    x = x_ref[...]  # [bm, K] activation dtype
+    w = q_ref[...]  # [K, bn] int8 — widened HERE, after the HBM read
+    acc = jax.lax.dot_general(
+        x, w.astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = (acc * s_ref[...]).astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, mult: int) -> jax.Array:
+    r = x.shape[axis] % mult
+    if not r:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, mult - r)
+    return jnp.pad(x, pad)
+
+
+def default_quant_interpret() -> bool:
+    """Mosaic-compile on TPU backends, interpret elsewhere — the shared
+    probe (``ops/_backend.py``)."""
+    from cs744_pytorch_distributed_tutorial_tpu.ops._backend import (
+        default_interpret,
+    )
+
+    return default_interpret()
+
+
+def int8_matmul(
+    x: jax.Array,
+    q: jax.Array,
+    scale: jax.Array,
+    *,
+    block_m: int = 512,
+    block_n: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x [..., K] @ dequant(q [K, N], scale [N]) -> [..., N]`` reading
+    the weight as int8 (half the HBM bytes of bf16). Leading dims of
+    ``x`` flatten into the row-block grid; K rides whole in VMEM (fine
+    through d_model 4096 at the default blocks). Shapes whose K is not
+    lane-aligned fall back to the XLA reference path."""
+    if q.ndim != 2 or scale.shape != (q.shape[1],):
+        raise ValueError(
+            f"expected q [K, N] and scale [N], got {q.shape} / {scale.shape}"
+        )
+    *lead, k = x.shape
+    if q.shape[0] != k:
+        raise ValueError(f"x K dim {k} != q K dim {q.shape[0]}")
+    if interpret is None:
+        interpret = default_quant_interpret()
+    if k % 128:
+        return int8_matmul_ref(x, q, scale)
+    n = q.shape[1]
+    x2 = x.reshape(-1, k)
+    m = x2.shape[0]
+    bm, bn = min(block_m, m), min(block_n, n)
+    # K rides whole per tile, so cap the block sizes as K grows or the
+    # x tile ([bm, K] activation dtype) and weight tile ([K, bn] int8)
+    # overflow VMEM at large d_ff (e.g. mlp_out's K = 4*d_model during
+    # prefill). Budgets leave headroom for Pallas double-buffering.
+    x_budget, w_budget = 2 << 20, 4 << 20
+    elt = jnp.dtype(x.dtype).itemsize
+    if k * elt * bm > x_budget:
+        bm = max(8, x_budget // (k * elt) // 8 * 8)
+    if k * bn > w_budget:
+        bn = max(128, w_budget // k // 128 * 128)
+    xp = _pad_to(x2, 0, bm)
+    qp = _pad_to(q, 1, bn)
+    sp = _pad_to(scale.astype(jnp.float32)[None, :], 1, bn)
+    mp, np_ = xp.shape[0], qp.shape[1]
+    spec_kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((mp, np_), x.dtype),
+        grid=(mp // bm, np_ // bn),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0), **spec_kw),
+            pl.BlockSpec((k, bn), lambda i, j: (0, j), **spec_kw),
+            pl.BlockSpec((1, bn), lambda i, j: (0, j), **spec_kw),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j), **spec_kw),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:m, :n].reshape(*lead, n)
+
+
+class QuantDense(nn.Module):
+    """Drop-in ``nn.Dense`` with an int8 kernel + per-channel scale.
+
+    Parameters are ``qkernel`` (int8, created by ``quantize_lm_params``
+    from a trained kernel — ``init`` only zero-fills them for shape) and
+    ``scale`` (f32); the optional bias stays float. Inference-only by
+    design: the matmul is non-differentiable on the int8 side.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    interpret: bool | None = None  # None = probe default backend
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        k = x.shape[-1]
+        qkernel = self.param(
+            "qkernel",
+            lambda _, shape, dtype: jnp.zeros(shape, dtype),
+            (k, self.features),
+            jnp.int8,
+        )
+        scale = self.param(
+            "scale",
+            lambda _, shape, dtype: jnp.ones(shape, dtype),
+            (self.features,),
+            jnp.float32,
+        )
+        y = int8_matmul(
+            x.astype(self.dtype), qkernel, scale, interpret=self.interpret
+        )
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (self.features,)
+            )
+            y = y + bias.astype(self.dtype)
+        return y
+
+
+# TransformerLM Dense modules whose kernels quantize (embeddings and
+# layernorms stay float; ``mlp_in``'s bias rides along unquantized).
+QUANT_MODULES = frozenset(
+    {"q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head"}
+)
+
+
+def quantize_lm_params(params) -> Any:
+    """Convert a trained ``TransformerLM`` param tree into the tree a
+    ``quant_dense=True`` clone expects: every ``QUANT_MODULES`` Dense's
+    ``kernel`` becomes ``(qkernel int8, scale f32)``; everything else
+    (biases, embeddings, layernorms) passes through unchanged. With
+    ``tie_embeddings=True`` there is no ``lm_head`` and the embedding's
+    ``attend`` path deliberately stays float."""
+
+    from collections.abc import Mapping
+
+    def walk(tree):
+        out = {}
+        for name, sub in tree.items():
+            if (
+                name in QUANT_MODULES
+                and isinstance(sub, Mapping)
+                and "kernel" in sub
+            ):
+                qkernel, scale = quantize_int8(jnp.asarray(sub["kernel"]))
+                new = {"qkernel": qkernel, "scale": scale}
+                for extra, leaf in sub.items():
+                    if extra != "kernel":
+                        new[extra] = leaf
+                out[name] = new
+            elif isinstance(sub, Mapping):
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    return walk(params)

@@ -104,7 +104,9 @@ def interpret_kernels(mesh: Mesh) -> bool:
     'axon' plugin). Decided from the mesh the computation actually runs
     on, not the global default backend — a TPU host can drive a CPU test
     mesh."""
-    return {d.platform for d in mesh.devices.flat}.isdisjoint({"tpu", "axon"})
+    from cs744_pytorch_distributed_tutorial_tpu.ops._backend import TPU_PLATFORMS
+
+    return {d.platform for d in mesh.devices.flat}.isdisjoint(TPU_PLATFORMS)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -363,6 +363,31 @@ class LMTrainer:
             attention_impl="dense", flash_interpret=None, remat=False
         )
 
+    def quantized_decode_model(self) -> TransformerLM:
+        """``decode_model`` with weight-only int8 projections
+        (``ops/quant.py``): every Dense kernel is stored int8 + per-channel
+        scale and dequantized inside the Pallas matmul, halving decode's
+        weight-read bandwidth. Pair with ``quantize_for_decode``::
+
+            qparams = trainer.quantize_for_decode(
+                trainer.gather_for_decode(params))
+            gen = make_generator(trainer.quantized_decode_model(),
+                                 max_new_tokens=64, temperature=0.0)
+            out = gen(qparams, prompt, jax.random.key(0))
+        """
+        return self.decode_model().clone(quant_dense=True)
+
+    @staticmethod
+    def quantize_for_decode(params):
+        """Convert trained (full, host-side) params into the int8 tree a
+        ``quantized_decode_model`` expects — see
+        ``ops/quant.py::quantize_lm_params``."""
+        from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+            quantize_lm_params,
+        )
+
+        return quantize_lm_params(params)
+
     def gather_for_decode(self, params):
         """Materialize tensor-/expert-sharded params as full host arrays
         (one all-gather + fetch) for the non-shard_map decode path

@@ -104,3 +104,14 @@ def test_pipeline_parallel_route(capsys):
             "--pipeline-parallel", "2", "--seq-parallel", "2",
             "--steps", "1",
         ])
+
+
+def test_lm_cli_int8_decode(capsys):
+    rc = main(TINY + [
+        "--vocab-size", "32", "--generate", "4", "--prompt-len", "4",
+        "--temperature", "0", "--int8-decode", "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(summary["sample"]) == 4
+    assert all(0 <= t < 32 for t in summary["sample"])
