@@ -1,17 +1,43 @@
-"""Decode throughput on the real chip: KV-cache generation, MHA vs GQA.
+"""Decode throughput on the real chip: KV-cache generation.
 
-Autoregressive decoding is bandwidth-bound on the KV cache; grouped-query
-attention shrinks the cache by H/KV. Measures generated tokens/sec for
-the jitted sampling loop (infer/generate.py). Run: python
-benchmarks/bench_generate.py
+Autoregressive decoding is bound by HBM bandwidth (weights + KV cache
+re-read every step) and, for small models, by per-op latency on the
+step's serial dependency chain. Measures generated tokens/sec for the
+jitted sampling loop (infer/generate.py) across:
 
-Measured 2026-07-30 (one TPU v5e chip, this config, greedy):
-  kv_heads=8 (MHA)   61.9 ms/gen   66.1k tokens/sec
-  kv_heads=2 (GQA)   38.7 ms/gen  105.9k tokens/sec  (1.60x)
-  kv_heads=1 (MQA)   39.8 ms/gen  103.0k tokens/sec
-The grouped decode_attention reads the cache at kv width — the saving
-is real bandwidth, not just capacity; kv=1's tiny head tensors give a
-little back to layout overhead.
+- MHA vs GQA vs MQA KV-head counts (the cache-bandwidth lever);
+- weight-only int8 (ops/quant.py) at two scopes, on a toy 4L/512d model
+  AND a GPT-2-small-scale model (the regime split below).
+
+Timing methodology: the tunneled backend's round-trip latency is
+volatile (measured 3-30 ms within one session), so per-call timing with
+a fence per generation is RTT-contaminated. Instead each measurement
+dispatches CALLS generations back-to-back (they pipeline on device —
+each depends only on params) and fences ONCE; best-of-3 rounds,
+variants interleaved so drift hits all of them equally.
+
+Measured 2026-07-31 (one TPU v5e chip, greedy, best-of-rounds):
+
+kv sweep (toy 4L/512d): MHA 69.5k / GQA-2 116.2k / MQA 150.3k tok/s
+toy 4L/512d/kv2, vocab 32k (weights ~54 MB bf16):
+  bf16       35.1 ms/gen  116.7k tok/s
+  int8 head  37.1 ms/gen  110.5k tok/s (0.95x)
+  int8 all   38.8 ms/gen  105.5k tok/s (0.90x)
+GPT-2-small 12L/768d/kv4, vocab 50304 (weights ~325 MB bf16):
+  bf16      106.5 ms/gen  19.2k tok/s
+  int8 head  91.2 ms/gen  22.5k tok/s (1.17x, reproduced 1.167x/1.168x)
+  int8 all  104.7 ms/gen  19.6k tok/s (1.02x)
+
+The regime split the numbers pin: at toy scale the decode step is
+op-latency-bound (~128 us/step against ~66 us of weight reads — the
+reads hide under the serial chain), so int8 only adds Pallas-call
+overhead. At GPT-2 scale the step is bandwidth-bound and quantizing the
+wide lm_head matmul alone wins 1.17x, while quantizing the 24 small
+per-layer projections gives the win back in per-call dispatch cost —
+hence ``QUANT_HEAD_ONLY`` is the decode default
+(``LMTrainer.quantized_decode_model``).
+
+Run: python benchmarks/bench_generate.py
 """
 
 from __future__ import annotations
@@ -27,30 +53,79 @@ import jax.numpy as jnp
 
 from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
 from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+    QUANT_HEAD_ONLY,
+    QUANT_MODULES,
+    quantize_lm_params,
+)
 
 BATCH = 16
 PROMPT = 128
-NEW = 256
-REPEATS = 5
+CALLS = 8  # generations per timing batch (one fence at the end)
+ROUNDS = 3
 
 
-def _time_gen(generate, params, prompt) -> float:
-    out = generate(params, prompt, jax.random.key(2))  # compile
-    float(out[0, 0])
-    for _ in range(4):  # steady-state warm-up (see bench_lm.py)
-        out = generate(params, prompt, jax.random.key(2))
-    float(out[0, 0])
+def batch_time(gen, params, prompt, calls=CALLS) -> float:
+    outs = [gen(params, prompt, jax.random.key(2)) for _ in range(2)]
+    float(outs[-1][0, 0])  # steady-state warm
     t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        out = generate(params, prompt, jax.random.key(2))
-    float(out[0, 0])  # value fetch fences (see bench.py)
-    return (time.perf_counter() - t0) / REPEATS
+    outs = [gen(params, prompt, jax.random.key(2)) for _ in range(calls)]
+    float(outs[-1][0, 0])  # ONE fence: device work pipelines, RTT amortizes
+    return (time.perf_counter() - t0) / calls
 
 
-def main() -> None:
-    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import quantize_lm_params
+def run_block(title: str, model: TransformerLM, new_tokens: int) -> None:
+    print(title)
+    prompt = jax.random.randint(
+        jax.random.key(0), (BATCH, PROMPT), 0, model.vocab_size
+    )
+    params = model.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    variants: dict[str, tuple] = {
+        "bf16": (
+            make_generator(model, max_new_tokens=new_tokens, temperature=0.0),
+            params,
+        ),
+        "int8 head": (
+            make_generator(
+                model.clone(quant_dense=True, quant_modules=QUANT_HEAD_ONLY),
+                max_new_tokens=new_tokens,
+                temperature=0.0,
+            ),
+            quantize_lm_params(params, QUANT_HEAD_ONLY),
+        ),
+        "int8 all": (
+            make_generator(
+                model.clone(
+                    quant_dense=True,
+                    quant_modules=tuple(sorted(QUANT_MODULES)),
+                ),
+                max_new_tokens=new_tokens,
+                temperature=0.0,
+            ),
+            quantize_lm_params(params, tuple(sorted(QUANT_MODULES))),
+        ),
+    }
+    for gen, p in variants.values():  # compile
+        out = gen(p, prompt, jax.random.key(2))
+        float(out[0, 0])
+    best = {k: float("inf") for k in variants}
+    for _ in range(ROUNDS):  # interleave so tunnel drift hits all variants
+        for name, (gen, p) in variants.items():
+            best[name] = min(best[name], batch_time(gen, p, prompt))
+    base = best["bf16"]
+    for name, dt in best.items():
+        print(
+            f"  {name:10s} {dt * 1e3:7.1f} ms/gen  "
+            f"{BATCH * new_tokens / dt:9.0f} tok/s  ({base / dt:.3f}x vs bf16)"
+        )
 
-    prompt = jax.random.randint(jax.random.key(0), (BATCH, PROMPT), 0, 32768)
+
+def kv_block() -> None:
+    """MHA vs GQA vs MQA on the toy model — the KV-cache bandwidth lever
+    (the grouped decode_attention reads the cache at kv width)."""
+    print("kv-head sweep (4L/512d toy, bf16)")
     for kv in (8, 2, 1):
         model = TransformerLM(
             vocab_size=32768,
@@ -59,34 +134,61 @@ def main() -> None:
             num_kv_heads=kv,
             d_model=512,
             d_ff=2048,
-            max_seq_len=PROMPT + NEW,
+            max_seq_len=PROMPT + 256,
             dtype=jnp.bfloat16,
             attention_impl="dense",
             use_rope=True,
         )
-        params = model.init(
-            jax.random.key(1), jnp.zeros((1, 8), jnp.int32)
-        )["params"]
-        generate = make_generator(model, max_new_tokens=NEW, temperature=0.0)
-        dt = _time_gen(generate, params, prompt)
-        print(
-            f"kv_heads={kv}             {dt * 1e3:8.1f} ms/gen  "
-            f"{BATCH * NEW / dt:10.0f} tokens/sec"
+        prompt = jax.random.randint(
+            jax.random.key(0), (BATCH, PROMPT), 0, 32768
         )
-        if kv == 2:
-            # Weight-only int8 ablation on the GQA winner: same model,
-            # kernels stored int8 + per-channel scale, dequant inside
-            # the Pallas matmul (ops/quant.py).
-            qgen = make_generator(
-                model.clone(quant_dense=True), max_new_tokens=NEW,
-                temperature=0.0,
-            )
-            qdt = _time_gen(qgen, quantize_lm_params(params), prompt)
-            print(
-                f"kv_heads={kv} int8 dense  {qdt * 1e3:8.1f} ms/gen  "
-                f"{BATCH * NEW / qdt:10.0f} tokens/sec  "
-                f"({dt / qdt:.2f}x vs bf16)"
-            )
+        params = model.init(jax.random.key(1), jnp.zeros((1, 8), jnp.int32))[
+            "params"
+        ]
+        gen = make_generator(model, max_new_tokens=256, temperature=0.0)
+        out = gen(params, prompt, jax.random.key(2))
+        float(out[0, 0])
+        dt = min(batch_time(gen, params, prompt) for _ in range(ROUNDS))
+        print(
+            f"  kv_heads={kv}  {dt * 1e3:7.1f} ms/gen  "
+            f"{BATCH * 256 / dt:9.0f} tok/s"
+        )
+
+
+def main() -> None:
+    kv_block()
+    run_block(
+        "int8 ablation: toy 4L/512d/kv2 (op-latency-bound regime)",
+        TransformerLM(
+            vocab_size=32768,
+            num_layers=4,
+            num_heads=8,
+            num_kv_heads=2,
+            d_model=512,
+            d_ff=2048,
+            max_seq_len=PROMPT + 256,
+            dtype=jnp.bfloat16,
+            attention_impl="dense",
+            use_rope=True,
+        ),
+        new_tokens=256,
+    )
+    run_block(
+        "int8 ablation: GPT-2-small 12L/768d/kv4 (bandwidth-bound regime)",
+        TransformerLM(
+            vocab_size=50304,
+            num_layers=12,
+            num_heads=12,
+            num_kv_heads=4,
+            d_model=768,
+            d_ff=3072,
+            max_seq_len=PROMPT + 128,
+            dtype=jnp.bfloat16,
+            attention_impl="dense",
+            use_rope=True,
+        ),
+        new_tokens=128,
+    )
 
 
 if __name__ == "__main__":

@@ -133,11 +133,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
     p.add_argument("--top-p", type=float, default=None)
-    p.add_argument("--int8-decode", action="store_true",
-                   help="generate with weight-only int8 projections "
-                        "(ops/quant.py): kernels stored int8 + per-channel "
-                        "scale, dequantized inside the Pallas matmul — "
-                        "halves decode's weight-read bandwidth")
+    p.add_argument("--int8-decode", nargs="?", const="head", default=None,
+                   choices=["head", "all"], metavar="SCOPE",
+                   help="generate with weight-only int8 kernels "
+                        "(ops/quant.py): stored int8 + per-channel scale, "
+                        "dequantized inside the Pallas matmul. SCOPE 'head' "
+                        "(default) quantizes only the wide lm_head matmul — "
+                        "the measured decode-throughput win; 'all' also "
+                        "quantizes the per-layer projections (halves weight "
+                        "memory, but per-call dispatch cost loses wall-clock "
+                        "on small models)")
     p.add_argument("--beam", type=int, default=0, metavar="K",
                    help="beam-search decode with K beams instead of sampling")
     p.add_argument("--json", action="store_true")
@@ -418,9 +423,11 @@ def main(argv: list[str] | None = None) -> int:
             prompt_ids = tokens[:1, : args.prompt_len]
         host_params = jax.device_get(params)
         prompt_arr = np.asarray(prompt_ids, dtype=np.int32)
-        if args.int8_decode:
-            decode_model = trainer.quantized_decode_model()
-            host_params = trainer.quantize_for_decode(host_params)
+        if args.int8_decode is not None:
+            decode_model = trainer.quantized_decode_model(args.int8_decode)
+            host_params = trainer.quantize_for_decode(
+                host_params, args.int8_decode
+            )
         else:
             decode_model = trainer.decode_model()
         if args.beam > 0:

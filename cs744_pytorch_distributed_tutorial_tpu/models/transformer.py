@@ -145,7 +145,11 @@ class Attention(nn.Module):
     num_kv_heads: int | None = None
     # Weight-only int8 projections (ops/quant.py::QuantDense) — the
     # decode-bandwidth lever; params come from quantize_lm_params.
+    # quant_modules narrows which Dense modules quantize (per-call
+    # dispatch cost makes small projections a measured loss — see
+    # ops/quant.py::QUANT_HEAD_ONLY).
     quant_dense: bool = False
+    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
 
     @nn.compact
     def __call__(
@@ -194,9 +198,14 @@ class Attention(nn.Module):
         kv_local = kv_heads // self.tensor_axis_size if tp else kv_heads
         if tp:
             x = copy_to_tp_region(x, self.tensor_axis)
-        proj = partial(
-            _dense_cls(self.quant_dense), use_bias=False, dtype=self.dtype
-        )
+        def proj_cls(mod):
+            return _dense_cls(self.quant_dense and mod in self.quant_modules)
+
+        def proj(feats, name):
+            return proj_cls(name)(
+                feats, use_bias=False, dtype=self.dtype, name=name
+            )
+
         q = proj(heads_local * head_dim, name="q")(x)
         k = proj(kv_local * head_dim, name="k")(x)
         v = proj(kv_local * head_dim, name="v")(x)
@@ -320,7 +329,7 @@ class Attention(nn.Module):
                 "'ulysses', or 'ulysses_flash', or set seq_axis=None"
             )
         out = out.reshape(b, t, heads_local * head_dim).astype(self.dtype)
-        out = _dense_cls(self.quant_dense)(
+        out = proj_cls("attn_out")(
             d_model, use_bias=False, dtype=self.dtype, name="attn_out"
         )(out)
         if tp:
@@ -355,6 +364,7 @@ class Block(nn.Module):
     # 'dropout' rng); rate 0.0 is a no-op either way.
     dropout_rate: float = 0.0
     quant_dense: bool = False
+    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
 
     @nn.compact
     def __call__(
@@ -398,6 +408,7 @@ class Block(nn.Module):
             rope_base=self.rope_base,
             num_kv_heads=self.num_kv_heads,
             quant_dense=self.quant_dense,
+            quant_modules=self.quant_modules,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
         if self.dropout_rate > 0.0:
@@ -427,11 +438,11 @@ class Block(nn.Module):
         # Column-parallel in, row-parallel out; the out bias is a separate
         # parameter applied AFTER the tp psum (a row-parallel Dense's own
         # bias would be summed tensor_axis_size times).
-        h = _dense_cls(self.quant_dense)(
+        h = _dense_cls(self.quant_dense and "mlp_in" in self.quant_modules)(
             d_ff_local, dtype=self.dtype, name="mlp_in"
         )(h)
         h = nn.gelu(h)
-        h = _dense_cls(self.quant_dense)(
+        h = _dense_cls(self.quant_dense and "mlp_out" in self.quant_modules)(
             x.shape[-1], use_bias=False, dtype=self.dtype, name="mlp_out"
         )(h)
         if self.dropout_rate > 0.0:
@@ -501,9 +512,12 @@ class TransformerLM(nn.Module):
     # (step, data index, seq index) only.
     dropout_rate: float = 0.0
     # Weight-only int8 Dense kernels (ops/quant.py) — the decode
-    # bandwidth lever. Pair with params from ``quantize_lm_params``;
-    # see ``LMTrainer.quantized_decode_model``.
+    # bandwidth lever. Pair with params from ``quantize_lm_params``
+    # (same ``modules``); see ``LMTrainer.quantized_decode_model``.
+    # quant_modules narrows the set (QUANT_HEAD_ONLY is the measured
+    # decode default — per-call dispatch cost vs bytes saved).
     quant_dense: bool = False
+    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
 
     @nn.compact
     def __call__(
@@ -571,6 +585,7 @@ class TransformerLM(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 dropout_rate=self.dropout_rate,
                 quant_dense=self.quant_dense,
+                quant_modules=self.quant_modules,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults
@@ -587,7 +602,9 @@ class TransformerLM(nn.Module):
             # quant_dense deliberately leaves it float.
             logits = tok_embed.attend(x)
         else:
-            logits = _dense_cls(self.quant_dense)(
+            logits = _dense_cls(
+                self.quant_dense and "lm_head" in self.quant_modules
+            )(
                 self.vocab_size, use_bias=False, dtype=self.dtype, name="lm_head"
             )(x)
         return logits.astype(jnp.float32)

@@ -109,14 +109,19 @@ def int8_matmul(
     scale: jax.Array,
     *,
     block_m: int = 512,
-    block_n: int = 512,
+    block_n: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """``x [..., K] @ dequant(q [K, N], scale [N]) -> [..., N]`` reading
     the weight as int8 (half the HBM bytes of bf16). Leading dims of
     ``x`` flatten into the row-block grid; K rides whole in VMEM (fine
     through d_model 4096 at the default blocks). Shapes whose K is not
-    lane-aligned fall back to the XLA reference path."""
+    lane-aligned fall back to the XLA reference path.
+
+    ``block_n=None`` adapts to the row count: decode-time gemv (a few
+    rows against a wide weight) is per-grid-step-overhead-bound, so it
+    takes 2048-wide tiles (measured ~2x over 512 at the [16,512]x[512,
+    32768] head shape); matmul-shaped calls keep 512."""
     if q.ndim != 2 or scale.shape != (q.shape[1],):
         raise ValueError(
             f"expected q [K, N] and scale [N], got {q.shape} / {scale.shape}"
@@ -131,6 +136,8 @@ def int8_matmul(
     n = q.shape[1]
     x2 = x.reshape(-1, k)
     m = x2.shape[0]
+    if block_n is None:
+        block_n = 2048 if m <= 64 else 512
     bm, bn = min(block_m, m), min(block_n, n)
     # K rides whole per tile, so cap the block sizes as K grows or the
     # x tile ([bm, K] activation dtype) and weight tile ([K, bn] int8)
@@ -202,28 +209,40 @@ class QuantDense(nn.Module):
         return y
 
 
-# TransformerLM Dense modules whose kernels quantize (embeddings and
-# layernorms stay float; ``mlp_in``'s bias rides along unquantized).
+# All TransformerLM Dense modules whose kernels CAN quantize (embeddings
+# and layernorms stay float; ``mlp_in``'s bias rides along unquantized).
 QUANT_MODULES = frozenset(
     {"q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head"}
 )
+# Measured default (one v5e, bench_generate shapes): every Pallas call
+# in the decode step carries a fixed dispatch cost, so quantizing the
+# small per-layer projections LOSES to XLA while the wide head matmul —
+# most of the weight bytes at LM vocab sizes — wins. "head" quantizes
+# only lm_head; "all" is the full set for weight-memory-bound uses.
+QUANT_HEAD_ONLY = ("lm_head",)
 
 
-def quantize_lm_params(params) -> Any:
+def quantize_lm_params(params, modules=QUANT_MODULES) -> Any:
     """Convert a trained ``TransformerLM`` param tree into the tree a
-    ``quant_dense=True`` clone expects: every ``QUANT_MODULES`` Dense's
+    ``quant_dense=True`` clone expects: every ``modules`` Dense's
     ``kernel`` becomes ``(qkernel int8, scale f32)``; everything else
     (biases, embeddings, layernorms) passes through unchanged. With
     ``tie_embeddings=True`` there is no ``lm_head`` and the embedding's
-    ``attend`` path deliberately stays float."""
+    ``attend`` path deliberately stays float. ``modules`` must match the
+    model clone's ``quant_modules``."""
 
     from collections.abc import Mapping
+
+    modules = frozenset(modules)
+    unknown = modules - QUANT_MODULES
+    if unknown:
+        raise ValueError(f"unknown quant modules {sorted(unknown)}")
 
     def walk(tree):
         out = {}
         for name, sub in tree.items():
             if (
-                name in QUANT_MODULES
+                name in modules
                 and isinstance(sub, Mapping)
                 and "kernel" in sub
             ):

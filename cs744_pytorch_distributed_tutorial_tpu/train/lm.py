@@ -42,6 +42,24 @@ SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
 
 
+def _resolve_quant_modules(modules: str) -> tuple:
+    """Map the user-facing int8-decode scope name to the module tuple
+    (``ops/quant.py``): "head" = lm_head only (the measured decode win),
+    "all" = every Dense projection (the weight-memory-bound choice)."""
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+        QUANT_HEAD_ONLY,
+        QUANT_MODULES,
+    )
+
+    if modules == "head":
+        return QUANT_HEAD_ONLY
+    if modules == "all":
+        return tuple(sorted(QUANT_MODULES))
+    raise ValueError(
+        f"unknown int8-decode scope {modules!r}; choose 'head' or 'all'"
+    )
+
+
 def evaluate_heldout(trainer, params, tokens) -> dict[str, float]:
     """Shared held-out evaluation contract (LM + pipeline engines):
     mean next-token cross-entropy and perplexity (exp of it) over
@@ -363,11 +381,17 @@ class LMTrainer:
             attention_impl="dense", flash_interpret=None, remat=False
         )
 
-    def quantized_decode_model(self) -> TransformerLM:
+    def quantized_decode_model(self, modules: str = "head") -> TransformerLM:
         """``decode_model`` with weight-only int8 projections
-        (``ops/quant.py``): every Dense kernel is stored int8 + per-channel
-        scale and dequantized inside the Pallas matmul, halving decode's
-        weight-read bandwidth. Pair with ``quantize_for_decode``::
+        (``ops/quant.py``): selected Dense kernels are stored int8 + a
+        per-channel scale and dequantized inside the Pallas matmul.
+        ``modules="head"`` (default) quantizes only ``lm_head`` — the
+        measured decode win (the wide head matmul is most of the weight
+        bytes at LM vocab sizes, while per-call dispatch cost makes the
+        small per-layer projections a loss on the v5e);
+        ``modules="all"`` quantizes every projection — the
+        weight-MEMORY-bound choice. Pair with ``quantize_for_decode``
+        using the same ``modules``::
 
             qparams = trainer.quantize_for_decode(
                 trainer.gather_for_decode(params))
@@ -375,18 +399,20 @@ class LMTrainer:
                                  max_new_tokens=64, temperature=0.0)
             out = gen(qparams, prompt, jax.random.key(0))
         """
-        return self.decode_model().clone(quant_dense=True)
+        return self.decode_model().clone(
+            quant_dense=True, quant_modules=_resolve_quant_modules(modules)
+        )
 
     @staticmethod
-    def quantize_for_decode(params):
+    def quantize_for_decode(params, modules: str = "head"):
         """Convert trained (full, host-side) params into the int8 tree a
-        ``quantized_decode_model`` expects — see
+        ``quantized_decode_model(modules)`` expects — see
         ``ops/quant.py::quantize_lm_params``."""
         from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
             quantize_lm_params,
         )
 
-        return quantize_lm_params(params)
+        return quantize_lm_params(params, _resolve_quant_modules(modules))
 
     def gather_for_decode(self, params):
         """Materialize tensor-/expert-sharded params as full host arrays

@@ -134,6 +134,41 @@ def test_quantized_forward_logits_close():
     assert rel.mean() < 0.01, rel.mean()
 
 
+def test_head_only_scope():
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import QUANT_HEAD_ONLY
+
+    model = _small_lm(False)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    qparams = quantize_lm_params(params, QUANT_HEAD_ONLY)
+    # Only the head converts; per-layer projections keep float kernels.
+    assert qparams["lm_head"]["qkernel"].dtype == jnp.int8
+    assert "kernel" in qparams["block_0"]["attn"]["q"]
+    qmodel = _small_lm(False).clone(
+        quant_dense=True, quant_modules=QUANT_HEAD_ONLY
+    )
+    ref = qmodel.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    assert jax.tree_util.tree_structure(ref) == jax.tree_util.tree_structure(
+        qparams
+    )
+    tokens = jax.random.randint(jax.random.key(7), (2, 16), 0, 512)
+    logits = model.apply({"params": params}, tokens)
+    qlogits = qmodel.apply({"params": qparams}, tokens)
+    denom = np.maximum(np.abs(np.asarray(logits)), 1.0)
+    rel = np.abs(np.asarray(qlogits) - np.asarray(logits)) / denom
+    # One quantized matmul's worth of noise — tighter than the all-module
+    # envelope in test_quantized_forward_logits_close.
+    assert rel.max() < 0.05, rel.max()
+
+
+def test_unknown_quant_module_rejected():
+    import pytest
+
+    model = _small_lm(False)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    with pytest.raises(ValueError, match="unknown quant modules"):
+        quantize_lm_params(params, ("lm_head", "tok_embed"))
+
+
 def test_quantized_generation_runs_and_tracks_float():
     from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
 
