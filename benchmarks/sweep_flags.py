@@ -10,6 +10,16 @@ steps/sec. Short windows — this ranks candidates; anything that wins
 here gets promoted to ``bench.py`` and re-measured at the full window.
 
 Run: python benchmarks/sweep_flags.py
+
+MEASURED (round 3, one v5e, batch 1024, quiet machine): the r2 baseline
+options WIN — every candidate lands at or below 34,338 sps (dot-dot
+fusion ties at 34,331; higher vmem budgets 98304/131072 LOSE 3-8%, so
+65536 is the peak of that curve, and dropping it costs 6%). An earlier
+sweep run concurrent with the CPU test suite showed four candidates
+"+2-3.5%" — pure load noise, all of them regressed to baseline when
+quiet. Two lessons recorded: (a) the scored step's compile-option
+surface is exhausted — further gains need code, not flags; (b) never
+rank compiler options on a loaded host.
 """
 
 from __future__ import annotations

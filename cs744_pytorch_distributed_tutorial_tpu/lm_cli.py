@@ -143,6 +143,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "quantizes the per-layer projections (halves weight "
                         "memory, but per-call dispatch cost loses wall-clock "
                         "on small models)")
+    p.add_argument("--int8-kv-cache", action="store_true",
+                   help="store the decode KV cache int8 with per-row "
+                        "scales (ops/quant.py::quantize_kv) — the "
+                        "long-context decode bandwidth lever; composes "
+                        "with --int8-decode")
     p.add_argument("--beam", type=int, default=0, metavar="K",
                    help="beam-search decode with K beams instead of sampling")
     p.add_argument("--json", action="store_true")
@@ -315,6 +320,17 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
+    if args.int8_decode == "head" and args.tie_embeddings:
+        # Fail BEFORE training: tied embeddings have no lm_head, so the
+        # default weight scope would silently quantize nothing
+        # (LMTrainer.quantized_decode_model raises the same way).
+        raise SystemExit(
+            "--int8-decode head is a no-op with --tie-embeddings (no "
+            "lm_head exists; the attend path stays float) — use "
+            "'--int8-decode all', or --int8-kv-cache which needs no "
+            "weight scope"
+        )
+
     import jax
     import numpy as np
 
@@ -424,10 +440,14 @@ def main(argv: list[str] | None = None) -> int:
         host_params = jax.device_get(params)
         prompt_arr = np.asarray(prompt_ids, dtype=np.int32)
         if args.int8_decode is not None:
-            decode_model = trainer.quantized_decode_model(args.int8_decode)
+            decode_model = trainer.quantized_decode_model(
+                args.int8_decode, kv_cache=args.int8_kv_cache
+            )
             host_params = trainer.quantize_for_decode(
                 host_params, args.int8_decode
             )
+        elif args.int8_kv_cache:
+            decode_model = trainer.decode_model().clone(quant_kv_cache=True)
         else:
             decode_model = trainer.decode_model()
         if args.beam > 0:

@@ -150,6 +150,10 @@ class Attention(nn.Module):
     # ops/quant.py::QUANT_HEAD_ONLY).
     quant_dense: bool = False
     quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
+    # Int8 KV cache (ops/quant.py::quantize_kv): rows stored int8 with a
+    # per-(batch, position, head) scale — the long-context decode
+    # bandwidth lever, independent of quant_dense.
+    quant_kv_cache: bool = False
 
     @nn.compact
     def __call__(
@@ -246,17 +250,63 @@ class Attention(nn.Module):
                 )
             # Only KV heads are cached — with GQA this is the
             # num_heads/num_kv_heads memory and bandwidth saving per
-            # decode step.
+            # decode step. With quant_kv_cache the rows are stored int8
+            # with a per-(batch, position, head) scale (ops/quant.py) —
+            # the LONG-context decode bandwidth lever: past a few
+            # thousand positions the cache, not the weights, is most of
+            # the bytes a decode step reads.
             cache_shape = (b, self.max_decode_len, kv_local, head_dim)
-            ck = self.variable("cache", "cached_key", jnp.zeros, cache_shape, k.dtype)
-            cv = self.variable(
-                "cache", "cached_value", jnp.zeros, cache_shape, v.dtype
+            cache_dtype = jnp.int8 if self.quant_kv_cache else k.dtype
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros, cache_shape, cache_dtype
             )
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros, cache_shape, cache_dtype
+            )
+            if self.quant_kv_cache:
+                cks = self.variable(
+                    "cache", "key_scale", jnp.ones, cache_shape[:3],
+                    jnp.float32,
+                )
+                cvs = self.variable(
+                    "cache", "value_scale", jnp.ones, cache_shape[:3],
+                    jnp.float32,
+                )
+
+            def write_cache(pos0) -> None:
+                if self.quant_kv_cache:
+                    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+                        quantize_kv,
+                    )
+
+                    kq, ks = quantize_kv(k)
+                    vq, vs = quantize_kv(v)
+                    ck.value = lax.dynamic_update_slice(
+                        ck.value, kq, (0, pos0, 0, 0)
+                    )
+                    cv.value = lax.dynamic_update_slice(
+                        cv.value, vq, (0, pos0, 0, 0)
+                    )
+                    cks.value = lax.dynamic_update_slice(
+                        cks.value, ks, (0, pos0, 0)
+                    )
+                    cvs.value = lax.dynamic_update_slice(
+                        cvs.value, vs, (0, pos0, 0)
+                    )
+                else:
+                    ck.value = lax.dynamic_update_slice(
+                        ck.value, k, (0, pos0, 0, 0)
+                    )
+                    cv.value = lax.dynamic_update_slice(
+                        cv.value, v, (0, pos0, 0, 0)
+                    )
+
             if mode == "prefill":
                 # Write the prompt's K/V at positions [0, t); attention
-                # itself is the ordinary causal pass below.
-                ck.value = lax.dynamic_update_slice(ck.value, k, (0, 0, 0, 0))
-                cv.value = lax.dynamic_update_slice(cv.value, v, (0, 0, 0, 0))
+                # itself is the ordinary causal pass below over the
+                # FRESH full-precision k/v (quantization error enters
+                # only where the cache is read back — decode steps).
+                write_cache(0)
             else:
                 if decode_pos is None:
                     raise ValueError("mode='decode' needs decode_pos")
@@ -265,12 +315,7 @@ class Attention(nn.Module):
                         f"mode='decode' is a single-token step, got t={t}; "
                         "feed multi-token chunks through mode='prefill'"
                     )
-                ck.value = lax.dynamic_update_slice(
-                    ck.value, k, (0, decode_pos, 0, 0)
-                )
-                cv.value = lax.dynamic_update_slice(
-                    cv.value, v, (0, decode_pos, 0, 0)
-                )
+                write_cache(decode_pos)
                 decode_step = True
 
         interpret = (
@@ -295,7 +340,16 @@ class Attention(nn.Module):
 
             k, v = repeat_kv(k, rep), repeat_kv(v, rep)
         if decode_step:
-            out = decode_attention(q, ck.value, cv.value, decode_pos)
+            if self.quant_kv_cache:
+                from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+                    decode_attention_quant,
+                )
+
+                out = decode_attention_quant(
+                    q, ck.value, cv.value, cks.value, cvs.value, decode_pos
+                )
+            else:
+                out = decode_attention(q, ck.value, cv.value, decode_pos)
         elif self.seq_axis is None or self.seq_axis_size == 1:
             if self.impl in ("flash", "ring_flash", "ulysses_flash"):
                 from cs744_pytorch_distributed_tutorial_tpu.ops.flash_attention import (
@@ -365,6 +419,10 @@ class Block(nn.Module):
     dropout_rate: float = 0.0
     quant_dense: bool = False
     quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
+    # Int8 KV cache (ops/quant.py::quantize_kv): rows stored int8 with a
+    # per-(batch, position, head) scale — the long-context decode
+    # bandwidth lever, independent of quant_dense.
+    quant_kv_cache: bool = False
 
     @nn.compact
     def __call__(
@@ -409,6 +467,7 @@ class Block(nn.Module):
             num_kv_heads=self.num_kv_heads,
             quant_dense=self.quant_dense,
             quant_modules=self.quant_modules,
+            quant_kv_cache=self.quant_kv_cache,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
         if self.dropout_rate > 0.0:
@@ -518,6 +577,10 @@ class TransformerLM(nn.Module):
     # decode default — per-call dispatch cost vs bytes saved).
     quant_dense: bool = False
     quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
+    # Int8 KV cache (ops/quant.py::quantize_kv): rows stored int8 with a
+    # per-(batch, position, head) scale — the long-context decode
+    # bandwidth lever, independent of quant_dense.
+    quant_kv_cache: bool = False
 
     @nn.compact
     def __call__(
@@ -586,6 +649,7 @@ class TransformerLM(nn.Module):
                 dropout_rate=self.dropout_rate,
                 quant_dense=self.quant_dense,
                 quant_modules=self.quant_modules,
+                quant_kv_cache=self.quant_kv_cache,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults

@@ -209,6 +209,67 @@ class QuantDense(nn.Module):
         return y
 
 
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-(batch, position, head) int8 quantization of K or V
+    rows ``[B, T, H, D]`` -> ``(q int8 [B, T, H, D], scale f32 [B, T, H])``
+    with ``q * scale[..., None] ~= x``. One scale per cache row keeps the
+    dequant a cheap per-key multiply applied AFTER the score/PV dot
+    (``decode_attention_quant``), and rows are quantized exactly once —
+    at cache-write time."""
+    x32 = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(x32 / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def decode_attention_quant(
+    q: jax.Array,
+    cached_k: jax.Array,
+    cached_v: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """``parallel/ring_attention.py::decode_attention`` over an int8 KV
+    cache: one decode step of ``q`` [B, 1, Hq, D] against ``cached_k``/
+    ``cached_v`` int8 [B, L, Hkv, D] with per-row scales [B, L, Hkv].
+
+    The cache mutates every step, so (unlike the weight path) XLA cannot
+    hoist the dequant out of the decode scan — reading int8 rows from
+    HBM is the win by itself and no Pallas kernel is needed. Dequant
+    rides outside the dots: scores pick up ``k_scale`` per key position
+    (algebraically identical to scaling K first), and ``v_scale`` folds
+    into the probabilities before the PV contraction. Positions > ``pos``
+    are masked exactly as in the float variant.
+    """
+    b, one, hq, d = q.shape
+    hkv = cached_k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    group = hq // hkv
+    qg = q.reshape(b, one, hkv, group, d)
+    scale = d**-0.5
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk",
+        qg.astype(jnp.float32),
+        cached_k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    neg = jnp.float32(-1e30)
+    k_pos = jnp.arange(cached_k.shape[1])
+    scores = jnp.where(k_pos[None, None, None, None, :] <= pos, scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    pv = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", pv, cached_v.astype(jnp.float32)
+    )
+    return out.reshape(b, one, hq, d).astype(q.dtype)
+
+
 # All TransformerLM Dense modules whose kernels CAN quantize (embeddings
 # and layernorms stay float; ``mlp_in``'s bias rides along unquantized).
 QUANT_MODULES = frozenset(

@@ -381,7 +381,9 @@ class LMTrainer:
             attention_impl="dense", flash_interpret=None, remat=False
         )
 
-    def quantized_decode_model(self, modules: str = "head") -> TransformerLM:
+    def quantized_decode_model(
+        self, modules: str = "head", kv_cache: bool = False
+    ) -> TransformerLM:
         """``decode_model`` with weight-only int8 projections
         (``ops/quant.py``): selected Dense kernels are stored int8 + a
         per-channel scale and dequantized inside the Pallas matmul.
@@ -390,8 +392,11 @@ class LMTrainer:
         bytes at LM vocab sizes, while per-call dispatch cost makes the
         small per-layer projections a loss on the v5e);
         ``modules="all"`` quantizes every projection — the
-        weight-MEMORY-bound choice. Pair with ``quantize_for_decode``
-        using the same ``modules``::
+        weight-MEMORY-bound choice. ``kv_cache=True`` additionally stores
+        the KV cache int8 with per-row scales (``quantize_kv``) — the
+        LONG-context lever, orthogonal to the weight scopes (params need
+        no conversion for it; the cache is written at run time). Pair
+        with ``quantize_for_decode`` using the same ``modules``::
 
             qparams = trainer.quantize_for_decode(
                 trainer.gather_for_decode(params))
@@ -399,8 +404,20 @@ class LMTrainer:
                                  max_new_tokens=64, temperature=0.0)
             out = gen(qparams, prompt, jax.random.key(0))
         """
+        if self.cfg.tie_embeddings and modules == "head":
+            # Tied embeddings have no lm_head module (logits ride
+            # tok_embed.attend, deliberately float) — the default scope
+            # would silently quantize NOTHING.
+            raise ValueError(
+                "int8-decode scope 'head' is a no-op with tied embeddings "
+                "(no lm_head exists; the attend path stays float) — use "
+                "modules='all' for the per-layer projections, or "
+                "kv_cache=True which needs no weight scope"
+            )
         return self.decode_model().clone(
-            quant_dense=True, quant_modules=_resolve_quant_modules(modules)
+            quant_dense=True,
+            quant_modules=_resolve_quant_modules(modules),
+            quant_kv_cache=kv_cache,
         )
 
     @staticmethod

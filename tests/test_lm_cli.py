@@ -106,12 +106,31 @@ def test_pipeline_parallel_route(capsys):
         ])
 
 
-def test_lm_cli_int8_decode(capsys):
+@pytest.mark.parametrize(
+    "flags",
+    [
+        ["--int8-decode"],                      # weight scope only
+        ["--int8-kv-cache"],                    # cache only (bf16 weights)
+        ["--int8-decode", "--int8-kv-cache"],   # composed
+        ["--int8-decode", "all"],               # explicit full weight scope
+    ],
+    ids=["weights", "kv-cache", "both", "all-scope"],
+)
+def test_lm_cli_int8_decode(capsys, flags):
     rc = main(TINY + [
         "--vocab-size", "32", "--generate", "4", "--prompt-len", "4",
-        "--temperature", "0", "--int8-decode", "--json",
+        "--temperature", "0", "--json", *flags,
     ])
     assert rc == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert len(summary["sample"]) == 4
     assert all(0 <= t < 32 for t in summary["sample"])
+
+
+def test_lm_cli_int8_head_scope_rejected_with_tied_embeddings(capsys):
+    with pytest.raises(SystemExit):
+        main(TINY + [
+            "--vocab-size", "32", "--tie-embeddings", "--generate", "4",
+            "--prompt-len", "4", "--temperature", "0", "--int8-decode",
+            "--json",
+        ])

@@ -187,3 +187,71 @@ def test_quantized_generation_runs_and_tracks_float():
     # not all.
     agree = (out == qout).mean()
     assert agree >= 0.5, (agree, out, qout)
+
+
+def test_quantize_kv_roundtrip_error():
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import quantize_kv
+
+    x = jax.random.normal(jax.random.key(8), (2, 16, 4, 64), jnp.float32)
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 16, 4)
+    deq = q.astype(jnp.float32) * np.asarray(scale)[..., None]
+    err = np.abs(np.asarray(deq) - np.asarray(x))
+    # Per-row symmetric: error bounded by half a step of that row's scale.
+    assert (err <= np.asarray(scale)[..., None] * 0.5 + 1e-7).all()
+
+
+def test_decode_attention_quant_tracks_float():
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+        decode_attention_quant,
+        quantize_kv,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        decode_attention,
+    )
+
+    kq, kk, kv_ = jax.random.split(jax.random.key(9), 3)
+    b, L, hq, hkv, d = 2, 32, 8, 2, 64
+    q = jax.random.normal(kq, (b, 1, hq, d), jnp.float32)
+    k = jax.random.normal(kk, (b, L, hkv, d), jnp.float32)
+    v = jax.random.normal(kv_, (b, L, hkv, d), jnp.float32)
+    pos = jnp.asarray(20, jnp.int32)
+    want = np.asarray(decode_attention(q, k, v, pos))
+    kq8, ks = quantize_kv(k)
+    vq8, vs = quantize_kv(v)
+    got = np.asarray(decode_attention_quant(q, kq8, vq8, ks, vs, pos))
+    # Int8 KV noise stays small relative to the attention output scale.
+    denom = np.maximum(np.abs(want), 0.1)
+    assert (np.abs(got - want) / denom).mean() < 0.02
+    # Masked region must not leak: positions > pos get exactly 0 weight,
+    # so perturbing them changes nothing.
+    vq8_b = vq8.at[:, 25:].set(127)
+    got2 = np.asarray(decode_attention_quant(q, kq8, vq8_b, ks, vs, pos))
+    np.testing.assert_array_equal(got, got2)
+
+
+def test_quant_kv_cache_generation_tracks_float():
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    model = _small_lm(False)
+    prompt = jax.random.randint(jax.random.key(10), (2, 8), 0, 512)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    gen = make_generator(model, max_new_tokens=8, temperature=0.0)
+    qgen = make_generator(
+        model.clone(quant_kv_cache=True), max_new_tokens=8, temperature=0.0
+    )
+    out = np.asarray(gen(params, prompt, jax.random.key(6)))
+    qout = np.asarray(qgen(params, prompt, jax.random.key(6)))
+    assert qout.shape == out.shape
+    assert (out == qout).mean() >= 0.5, (out, qout)
+
+
+def test_quant_kv_cache_beam_runs():
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_beam_searcher
+
+    model = _small_lm(False).clone(quant_kv_cache=True)
+    prompt = jax.random.randint(jax.random.key(11), (1, 6), 0, 512)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    search = make_beam_searcher(model, beam_size=2, max_new_tokens=4)
+    out, scores = search(params, prompt)
+    assert out.shape == (1, 4) and np.isfinite(np.asarray(scores)).all()
