@@ -13,16 +13,24 @@ hardware does ~1 extra forward of block FLOPs on top).
 Run on the TPU: python benchmarks/bench_lm_gpt2.py
 Prints one JSON line per configuration; headline = flash + fused_xent.
 
-Measured 2026-07-31 (one TPU v5e chip, batch 8):
-  dense           135.7 ms/step   60.4k tok/s  MFU 0.262
-  flash            84.4 ms/step   97.1k tok/s  MFU 0.421  (1.61x)
-  dense+fxent     145.6 ms/step   56.3k tok/s  MFU 0.244
-  flash+fxent      96.2 ms/step   85.2k tok/s  MFU 0.370
+Measured 2026-07-31 (one TPU v5e chip, batch 8; re-run later same day
+in parens):
+  dense           135.7 ms/step   60.4k tok/s  MFU 0.262  (61.0k/0.265)
+  flash            84.4 ms/step   97.1k tok/s  MFU 0.421  (98.8k/0.429)
+  dense+fxent     145.6 ms/step   56.3k tok/s  MFU 0.244  (56.0k/0.243)
+  flash+fxent      96.2 ms/step   85.2k tok/s  MFU 0.370  (83.5k/0.362)
 The flash win SURVIVES depth (1.61x at 12L vs 1.62x at 4L);
 fused_xent LOSES 12-14% wall-clock in training at this vocab (also at
 batch 16) — its value is the absent [N, V] log-softmax buffer when
 memory binds, and its off-by-default is now measured, not assumed
 (table + discussion in benchmarks/README.md).
+
+Batch scaling (measured, negative): flash at batch 16 is 94.5k tok/s
+(MFU 0.41 — no better than batch 8; the d768 matmuls are already
+MXU-shaped), and batch 32 fails to compile through the tunnel's remote
+compile helper (HTTP 500, both with and without fused_xent — the
+regime fused_xent's memory saving targets is unreachable on this
+single tunneled chip). The batch-8 headline stands.
 """
 
 from __future__ import annotations
@@ -66,7 +74,7 @@ def gpt2ish_train_flops_per_token() -> float:
     return 3.0 * fwd
 
 
-def bench_config(attention_impl: str, fused_xent: bool) -> dict:
+def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH) -> dict:
     cfg = LMConfig(
         vocab_size=VOCAB,
         num_layers=LAYERS,
@@ -75,7 +83,7 @@ def bench_config(attention_impl: str, fused_xent: bool) -> dict:
         d_ff=D_FF,
         max_seq_len=SEQ,
         seq_len=SEQ,
-        global_batch_size=BATCH,
+        global_batch_size=batch,
         attention_impl=attention_impl,
         compute_dtype="bfloat16",
         remat=True,
@@ -86,7 +94,7 @@ def bench_config(attention_impl: str, fused_xent: bool) -> dict:
     mesh = make_mesh({"data": 1, "seq": 1})
     tr = LMTrainer(cfg, mesh=mesh)
     params, opt = tr.init()
-    tokens = synthetic_tokens(BATCH, SEQ, VOCAB, seed=0)
+    tokens = synthetic_tokens(batch, SEQ, VOCAB, seed=0)
     x, y = tr.shard_batch(tokens)
 
     params, opt, m = tr.train_step(params, opt, x, y)  # compile
@@ -99,7 +107,7 @@ def bench_config(attention_impl: str, fused_xent: bool) -> dict:
         params, opt, m = tr.train_step(params, opt, x, y)
     float(m["loss"])
     dt = (time.perf_counter() - t0) / STEPS
-    tok_s = BATCH * SEQ / dt
+    tok_s = batch * SEQ / dt
     flops = gpt2ish_train_flops_per_token()
     return {
         "metric": "gpt2small_train_tokens_per_sec_per_chip",
@@ -114,7 +122,7 @@ def bench_config(attention_impl: str, fused_xent: bool) -> dict:
             else None
         ),
         "config": f"{LAYERS}L/{D_MODEL}d/{HEADS}h/T{SEQ}/V{VOCAB}"
-                  f"/b{BATCH}/bf16/remat=dots/rope",
+                  f"/b{batch}/bf16/remat=dots/rope",
     }
 
 
@@ -123,9 +131,22 @@ def main() -> None:
         ("dense", False),
         ("flash", False),
         ("dense", True),
-        ("flash", True),  # headline: both kernels on
+        ("flash", True),
     ):
         print(json.dumps(bench_config(impl, fused)), flush=True)
+    # Batch scaling: batch 8 under-fills the MXU on d768 matmuls; larger
+    # batches raise MFU until memory binds. At batch 32 the f32 logit
+    # buffer alone is ~6.6 GB — the regime fused_xent's absent [N, V]
+    # log-softmax buffer targets, so it is ablated again here where its
+    # memory saving (not wall-clock) is the question.
+    for batch, fused in ((16, False), (32, False), (32, True)):
+        try:
+            print(json.dumps(bench_config("flash", fused, batch)), flush=True)
+        except Exception as e:
+            print(json.dumps({
+                "attention_impl": "flash", "fused_xent": fused,
+                "batch": batch, "error": f"{type(e).__name__}: {str(e)[:120]}",
+            }), flush=True)
 
 
 if __name__ == "__main__":

@@ -10,6 +10,16 @@ choices at the GPT-2 attention shape to decide whether a per-T default
 is worth carrying.
 
 Run: python benchmarks/probe_flash_blocks.py
+
+MEASURED (round 3, one v5e): a dead end, kept as the record. Isolated
+kernel timings at these shapes are dominated by per-call overhead
+(~4-6 ms against ~0.2 ms of actual per-layer attention compute), and the
+config-to-config deltas (±1 ms) do not replicate the causal-prune
+arithmetic — they are overhead noise. The decisive argument is upstream:
+at T=1024 causal attention is ~0.6% of a GPT-2-small training step's
+FLOPs (38 GF of 6.1 TF), so no block tuning can move the step; the
+1.6x flash-vs-dense win was about not materializing [B,H,T,T] scores
+through HBM, not attention FLOPs. Block defaults stay (512, 1024).
 """
 
 from __future__ import annotations

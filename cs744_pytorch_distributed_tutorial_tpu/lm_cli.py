@@ -56,6 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "matmul outputs and recompute elementwise only")
     p.add_argument("--tie-embeddings", action="store_true",
                    help="share the token embedding with the output head")
+    p.add_argument("--norm", default="layernorm",
+                   choices=["layernorm", "rmsnorm"],
+                   help="block normalization (rmsnorm = llama-family: no "
+                        "mean subtraction, no bias)")
+    p.add_argument("--mlp", default="gelu", choices=["gelu", "swiglu"],
+                   help="block MLP (swiglu = silu(gate(x)) * up(x) with a "
+                        "third column-parallel projection)")
     p.add_argument("--use-rope", action="store_true",
                    help="rotary position embeddings instead of the learned "
                         "absolute table")
@@ -256,6 +263,8 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         max_seq_len=args.max_seq_len,
         compute_dtype=args.compute_dtype,
         use_rope=args.use_rope,
+        norm=args.norm,
+        mlp=args.mlp,
         num_kv_heads=args.num_kv_heads,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
@@ -378,6 +387,8 @@ def main(argv: list[str] | None = None) -> int:
         remat_policy=args.remat_policy,
         tie_embeddings=args.tie_embeddings,
         use_rope=args.use_rope,
+        norm=args.norm,
+        mlp=args.mlp,
         fused_xent=args.fused_xent,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,

@@ -45,6 +45,20 @@ ATTENTION_IMPLS = (
 
 REMAT_POLICIES = ("none", "dots")
 
+NORM_IMPLS = ("layernorm", "rmsnorm")
+MLP_IMPLS = ("gelu", "swiglu")
+
+
+def _norm_cls(norm: str):
+    """The block's normalization layer: the GPT-2-style LayerNorm
+    default, or RMSNorm (no mean subtraction, no bias) — the
+    llama-family choice, cheaper on the VPU by one reduction pass."""
+    if norm == "layernorm":
+        return nn.LayerNorm
+    if norm == "rmsnorm":
+        return nn.RMSNorm
+    raise ValueError(f"unknown norm {norm!r}; choose from {NORM_IMPLS}")
+
 
 def _dense_cls(quant: bool):
     """``nn.Dense``, or the weight-only-int8 ``QuantDense`` under
@@ -149,7 +163,7 @@ class Attention(nn.Module):
     # dispatch cost makes small projections a measured loss — see
     # ops/quant.py::QUANT_HEAD_ONLY).
     quant_dense: bool = False
-    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
+    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_gate", "mlp_out", "lm_head")
     # Int8 KV cache (ops/quant.py::quantize_kv): rows stored int8 with a
     # per-(batch, position, head) scale — the long-context decode
     # bandwidth lever, independent of quant_dense.
@@ -418,11 +432,16 @@ class Block(nn.Module):
     # 'dropout' rng); rate 0.0 is a no-op either way.
     dropout_rate: float = 0.0
     quant_dense: bool = False
-    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
+    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_gate", "mlp_out", "lm_head")
     # Int8 KV cache (ops/quant.py::quantize_kv): rows stored int8 with a
     # per-(batch, position, head) scale — the long-context decode
     # bandwidth lever, independent of quant_dense.
     quant_kv_cache: bool = False
+    # Llama-family block options: norm ("layernorm" default | "rmsnorm")
+    # and MLP ("gelu" default | "swiglu": silu(gate(x)) * up(x) with a
+    # third column-parallel projection named mlp_gate).
+    norm: str = "layernorm"
+    mlp: str = "gelu"
 
     @nn.compact
     def __call__(
@@ -447,10 +466,23 @@ class Block(nn.Module):
             )
         d_ff_local = self.d_ff // self.tensor_axis_size if tp else self.d_ff
 
+        if self.mlp not in MLP_IMPLS:
+            raise ValueError(
+                f"unknown mlp {self.mlp!r}; choose from {MLP_IMPLS}"
+            )
+        if self.num_experts > 0 and self.mlp != "gelu":
+            # The MoE branch replaces the dense MLP entirely — a swiglu
+            # request would otherwise be silently ignored.
+            raise ValueError(
+                f"mlp={self.mlp!r} does not compose with MoE "
+                f"(num_experts={self.num_experts}): the routed MoEFFN "
+                "replaces the dense MLP; drop --mlp swiglu or the experts"
+            )
         drop = partial(
             nn.Dropout, rate=self.dropout_rate, deterministic=deterministic
         )
-        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        norm = partial(_norm_cls(self.norm), dtype=self.dtype)
+        h = norm(name="ln1")(x)
         attn_out = Attention(
             num_heads=self.num_heads,
             dtype=self.dtype,
@@ -473,7 +505,7 @@ class Block(nn.Module):
         if self.dropout_rate > 0.0:
             attn_out = drop(name="attn_drop")(attn_out)
         x = x + attn_out
-        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = norm(name="ln2")(x)
         if self.num_experts > 0:
             from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN
 
@@ -497,10 +529,18 @@ class Block(nn.Module):
         # Column-parallel in, row-parallel out; the out bias is a separate
         # parameter applied AFTER the tp psum (a row-parallel Dense's own
         # bias would be summed tensor_axis_size times).
-        h = _dense_cls(self.quant_dense and "mlp_in" in self.quant_modules)(
+        up = _dense_cls(self.quant_dense and "mlp_in" in self.quant_modules)(
             d_ff_local, dtype=self.dtype, name="mlp_in"
         )(h)
-        h = nn.gelu(h)
+        if self.mlp == "swiglu":
+            # silu(gate) * up — the gate is a third column-parallel
+            # projection, so TP sharding splits all three the same way.
+            gate = _dense_cls(
+                self.quant_dense and "mlp_gate" in self.quant_modules
+            )(d_ff_local, use_bias=False, dtype=self.dtype, name="mlp_gate")(h)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.gelu(up)
         h = _dense_cls(self.quant_dense and "mlp_out" in self.quant_modules)(
             x.shape[-1], use_bias=False, dtype=self.dtype, name="mlp_out"
         )(h)
@@ -576,11 +616,15 @@ class TransformerLM(nn.Module):
     # quant_modules narrows the set (QUANT_HEAD_ONLY is the measured
     # decode default — per-call dispatch cost vs bytes saved).
     quant_dense: bool = False
-    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head")
+    quant_modules: tuple = ("q", "k", "v", "attn_out", "mlp_in", "mlp_gate", "mlp_out", "lm_head")
     # Int8 KV cache (ops/quant.py::quantize_kv): rows stored int8 with a
     # per-(batch, position, head) scale — the long-context decode
     # bandwidth lever, independent of quant_dense.
     quant_kv_cache: bool = False
+    # Llama-family options (see Block.norm / Block.mlp): rmsnorm applies
+    # to the final norm too; swiglu adds the column-parallel mlp_gate.
+    norm: str = "layernorm"
+    mlp: str = "gelu"
 
     @nn.compact
     def __call__(
@@ -650,6 +694,8 @@ class TransformerLM(nn.Module):
                 quant_dense=self.quant_dense,
                 quant_modules=self.quant_modules,
                 quant_kv_cache=self.quant_kv_cache,
+                norm=self.norm,
+                mlp=self.mlp,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults
@@ -660,7 +706,7 @@ class TransformerLM(nn.Module):
                 x = block(x, deterministic)
             else:
                 x = block(x, mode=mode, decode_pos=decode_pos)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = _norm_cls(self.norm)(dtype=self.dtype, name="ln_f")(x)
         if self.tie_embeddings:
             # The attend path reuses the (unquantized) embedding table —
             # quant_dense deliberately leaves it float.
@@ -703,7 +749,7 @@ def lm_param_specs(params, tensor_axis: str | None, expert_axis: str | None = No
         if t is None:
             return P()
         leaf_name = names[-1]
-        if module in ("q", "k", "v"):
+        if module in ("q", "k", "v", "mlp_gate"):
             return P(None, t)
         if module in ("attn_out", "mlp_out"):
             return P(t, None)

@@ -273,7 +273,7 @@ def decode_attention_quant(
 # All TransformerLM Dense modules whose kernels CAN quantize (embeddings
 # and layernorms stay float; ``mlp_in``'s bias rides along unquantized).
 QUANT_MODULES = frozenset(
-    {"q", "k", "v", "attn_out", "mlp_in", "mlp_out", "lm_head"}
+    {"q", "k", "v", "attn_out", "mlp_in", "mlp_gate", "mlp_out", "lm_head"}
 )
 # Measured default (one v5e, bench_generate shapes): every Pallas call
 # in the decode step carries a fixed dispatch cost, so quantizing the

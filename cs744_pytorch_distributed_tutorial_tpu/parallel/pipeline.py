@@ -725,6 +725,9 @@ class PipelineLMConfig:
     use_rope: bool = False
     # Grouped-query attention: KV head count (None = num_heads).
     num_kv_heads: int | None = None
+    # Llama-family block options (models/transformer.py::Block).
+    norm: str = "layernorm"
+    mlp: str = "gelu"
 
     # MoE FFN (models/moe.py) in every block; with expert_parallel the
     # experts shard over the DATA axis (all-to-all dispatch inside the
@@ -974,6 +977,8 @@ class PipelineLMTrainer:
             rope=cfg.use_rope,
             num_kv_heads=cfg.num_kv_heads,
             dropout_rate=cfg.dropout_rate,
+            norm=cfg.norm,
+            mlp=cfg.mlp,
         )
         # Host-init clone: no mesh axes in scope, GLOBAL kernel shapes
         # (sharded by device_put afterwards) — same recipe as

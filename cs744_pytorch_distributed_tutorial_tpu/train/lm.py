@@ -145,6 +145,11 @@ class LMConfig:
     # Weight tying: logits = x @ tok_embed^T instead of a separate
     # lm_head (halves the vocab parameters).
     tie_embeddings: bool = False
+    # Llama-family block options (models/transformer.py): norm
+    # "layernorm"|"rmsnorm", mlp "gelu"|"swiglu" (swiglu adds the
+    # column-parallel mlp_gate projection; d_ff semantics unchanged).
+    norm: str = "layernorm"
+    mlp: str = "gelu"
 
     # Rotary position embeddings: relative positions inside attention
     # instead of the learned absolute table (exact under sequence
@@ -311,6 +316,8 @@ class LMTrainer:
             use_rope=cfg.use_rope,
             num_kv_heads=cfg.num_kv_heads,
             dropout_rate=cfg.dropout_rate,
+            norm=cfg.norm,
+            mlp=cfg.mlp,
         )
         if cfg.grad_clip_norm is not None and (
             self.tensor_size > 1 or self.expert_parallel

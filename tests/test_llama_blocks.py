@@ -1,0 +1,183 @@
+"""Llama-family block options: RMSNorm + SwiGLU (models/transformer.py).
+
+No counterpart in the reference (its only model is conv VGG-11,
+``master/part1/model.py:30-46``) — these are model-zoo completeness
+options on the transformer family: norm="rmsnorm" swaps every
+LayerNorm for RMSNorm (final norm included), mlp="swiglu" swaps the
+gelu MLP for silu(gate(x)) * up(x) with a third column-parallel
+projection ``mlp_gate``. Verified: formula parity against hand-written
+math, param-tree shape, tensor-parallel parity (the sharding rules
+extend to mlp_gate), decode parity, and the int8 path covering the gate.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+from cs744_pytorch_distributed_tutorial_tpu.models.transformer import Block
+
+
+def _lm(**kw) -> TransformerLM:
+    base = dict(
+        vocab_size=128,
+        num_layers=2,
+        num_heads=4,
+        d_model=64,
+        d_ff=128,
+        max_seq_len=32,
+        dtype=jnp.float32,
+        attention_impl="dense",
+        use_rope=True,
+        flash_interpret=True,
+    )
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+def test_swiglu_formula_matches_hand_math():
+    block = Block(
+        num_heads=2, d_ff=32, dtype=jnp.float32, impl="dense",
+        mlp="swiglu", norm="rmsnorm", flash_interpret=True,
+    )
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16), jnp.float32)
+    params = block.init(jax.random.key(1), x, True)["params"]
+
+    def rms(v, scale):
+        var = np.mean(np.asarray(v) ** 2, axis=-1, keepdims=True)
+        return np.asarray(v) / np.sqrt(var + 1e-6) * np.asarray(scale)
+
+    # Zero the attention kernels so attn_out == 0 and the block output
+    # isolates the MLP sublayer against hand-written swiglu math.
+    zeroed = jax.tree_util.tree_map(lambda a: a, params)
+    for mod in ("q", "k", "v", "attn_out"):
+        zeroed["attn"][mod]["kernel"] = jnp.zeros_like(
+            zeroed["attn"][mod]["kernel"]
+        )
+    out = np.asarray(block.apply({"params": zeroed}, x, True))
+    h2 = rms(x, zeroed["ln2"]["scale"])  # attn_out == 0 -> residual is x
+    up = h2 @ np.asarray(zeroed["mlp_in"]["kernel"]) + np.asarray(
+        zeroed["mlp_in"]["bias"]
+    )
+    gate = h2 @ np.asarray(zeroed["mlp_gate"]["kernel"])
+    silu = gate / (1.0 + np.exp(-gate)) * up
+    mlp = silu @ np.asarray(zeroed["mlp_out"]["kernel"]) + np.asarray(
+        zeroed["mlp_out_bias"]
+    )
+    np.testing.assert_allclose(out, np.asarray(x) + mlp, rtol=2e-5, atol=2e-5)
+
+
+def test_param_tree_has_gate_and_no_ln_bias():
+    model = _lm(norm="rmsnorm", mlp="swiglu")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    blk = params["block_0"]
+    assert "mlp_gate" in blk and "kernel" in blk["mlp_gate"]
+    assert blk["mlp_gate"]["kernel"].shape == (64, 128)
+    # RMSNorm has scale only — no bias param.
+    assert set(blk["ln1"].keys()) == {"scale"}
+    assert set(params["ln_f"].keys()) == {"scale"}
+    # gelu model has no gate.
+    p2 = _lm().init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    assert "mlp_gate" not in p2["block_0"]
+
+
+def test_unknown_options_rejected():
+    with pytest.raises(ValueError, match="unknown norm"):
+        _lm(norm="batchnorm").init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )
+    with pytest.raises(ValueError, match="unknown mlp"):
+        _lm(mlp="geglu").init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )
+
+
+def test_tensor_parallel_swiglu_parity(devices):
+    """mlp_gate is column-parallel: the TP model on a 2-device tensor
+    axis must reproduce the single-device logits."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+        lm_param_specs,
+    )
+
+    full = _lm(norm="rmsnorm", mlp="swiglu")
+    tokens = jax.random.randint(jax.random.key(2), (2, 8), 0, 128)
+    params = full.init(jax.random.key(0), tokens)["params"]
+    want = np.asarray(full.apply({"params": params}, tokens))
+
+    mesh = Mesh(np.array(devices[:2]), ("tensor",))
+    tp_model = full.clone(tensor_axis="tensor", tensor_axis_size=2)
+    specs = lm_param_specs(params, "tensor")
+    assert specs["block_0"]["mlp_gate"]["kernel"] == P(None, "tensor")
+
+    def fwd(p, t):
+        return tp_model.apply({"params": p}, t)
+
+    got = jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(specs, P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )(params, tokens)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_swiglu_decode_matches_teacher_forcing():
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    model = _lm(norm="rmsnorm", mlp="swiglu")
+    prompt = jax.random.randint(jax.random.key(3), (2, 8), 0, 128)
+    params = model.init(jax.random.key(0), prompt)["params"]
+    gen = make_generator(model, max_new_tokens=6, temperature=0.0)
+    out = np.asarray(gen(params, prompt, jax.random.key(4)))
+    # Teacher-forced re-check: feeding prompt+generated through the full
+    # forward must greedily re-predict each generated token.
+    seq = np.concatenate([np.asarray(prompt), out], axis=1)
+    logits = np.asarray(model.apply({"params": params}, jnp.asarray(seq)))
+    for i in range(out.shape[1]):
+        np.testing.assert_array_equal(
+            out[:, i], logits[:, 8 + i - 1].argmax(-1)
+        )
+
+
+def test_int8_all_scope_covers_gate():
+    from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+        QUANT_MODULES,
+        quantize_lm_params,
+    )
+
+    model = _lm(norm="rmsnorm", mlp="swiglu")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    mods = tuple(sorted(QUANT_MODULES))
+    qparams = quantize_lm_params(params, mods)
+    assert qparams["block_0"]["mlp_gate"]["qkernel"].dtype == jnp.int8
+    qmodel = model.clone(quant_dense=True, quant_modules=mods)
+    ref = qmodel.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    assert jax.tree_util.tree_structure(ref) == jax.tree_util.tree_structure(
+        qparams
+    )
+    tokens = jax.random.randint(jax.random.key(5), (2, 8), 0, 128)
+    logits = model.apply({"params": params}, tokens)
+    qlogits = qmodel.apply({"params": qparams}, tokens)
+    denom = np.maximum(np.abs(np.asarray(logits)), 1.0)
+    assert (np.abs(np.asarray(qlogits) - np.asarray(logits)) / denom).max() < 0.1
+
+
+def test_swiglu_moe_combination_rejected():
+    with pytest.raises(ValueError, match="does not compose with MoE"):
+        _lm(mlp="swiglu", num_experts=4).init(
+            jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+        )

@@ -134,3 +134,26 @@ def test_lm_cli_int8_head_scope_rejected_with_tied_embeddings(capsys):
             "--prompt-len", "4", "--temperature", "0", "--int8-decode",
             "--json",
         ])
+
+
+def test_lm_cli_llama_options_both_engines(capsys):
+    # shard_map engine with rmsnorm + swiglu, incl. generation.
+    rc = main(TINY + [
+        "--vocab-size", "32", "--norm", "rmsnorm", "--mlp", "swiglu",
+        "--use-rope", "--generate", "4", "--prompt-len", "4",
+        "--temperature", "0", "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert np.isfinite(summary["final_loss"]) and len(summary["sample"]) == 4
+    # pipeline engine stages the same Block with the same options.
+    rc = main([
+        "--pipeline-parallel", "2", "--norm", "rmsnorm", "--mlp", "swiglu",
+        "--num-layers", "2", "--num-heads", "2", "--d-model", "32",
+        "--d-ff", "64", "--max-seq-len", "32", "--seq-len", "16",
+        "--global-batch-size", "8", "--num-seqs", "16", "--steps", "2",
+        "--log-every", "1", "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["engine"] == "pipeline" and summary["finite"]
