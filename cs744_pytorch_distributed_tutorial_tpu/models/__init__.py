@@ -33,6 +33,10 @@ from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
     vgg16,
     vgg19,
 )
+from cs744_pytorch_distributed_tutorial_tpu.models.hf_interop import (
+    gpt2_model_config,
+    lm_params_from_hf_gpt2,
+)
 from cs744_pytorch_distributed_tutorial_tpu.models.torch_interop import (
     torch_state_dict_from_vgg_variables,
     vgg_variables_from_torch_state_dict,
@@ -117,6 +121,8 @@ __all__ = [
     "resnet34",
     "resnet50",
     "tiny_cnn",
+    "gpt2_model_config",
+    "lm_params_from_hf_gpt2",
     "torch_state_dict_from_vgg_variables",
     "vgg_variables_from_torch_state_dict",
     "vgg11",

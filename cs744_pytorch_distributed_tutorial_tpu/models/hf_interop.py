@@ -1,0 +1,167 @@
+"""HuggingFace GPT-2 checkpoint import — the LM switching path.
+
+The VGG converter (``models/torch_interop.py``) moves the reference's
+own model across; this moves the ecosystem's most common LM checkpoint
+family: a ``transformers`` GPT-2 ``state_dict`` (``GPT2LMHeadModel``)
+converts into a ``TransformerLM`` variables tree with logit parity.
+No counterpart exists in the reference (its only model is conv VGG-11,
+``master/part1/model.py:30-46``).
+
+Architecture mapping (GPT-2 -> this framework's ``TransformerLM``):
+
+- pre-LN residual blocks, learned absolute positions (``wpe``), tied
+  embeddings (``lm_head = wte``) — the model is constructed via
+  ``gpt2_model_config`` with ``use_rope=False, tie_embeddings=True,
+  norm="layernorm", mlp="gelu"`` (HF's ``gelu_new`` is the tanh
+  approximation, flax's ``nn.gelu`` default), ``norm_eps=1e-5`` (HF's
+  ``layer_norm_epsilon``), and ``attn_bias=True`` (GPT-2 keeps biases
+  on every projection);
+- HF's fused ``c_attn`` [d, 3d] Conv1D splits column-wise into the
+  separate q/k/v kernels (HF ``Conv1D.weight`` is already
+  [in, out] — flax ``Dense`` kernel orientation, NO transpose);
+- ``c_proj`` -> ``attn_out``; ``mlp.c_fc`` -> ``mlp_in``;
+  ``mlp.c_proj`` kernel -> ``mlp_out`` + its bias -> the post-residual
+  ``mlp_out_bias`` (this framework separates the row-parallel bias;
+  algebraically identical placement);
+- ``ln_1``/``ln_2``/``ln_f`` -> ``ln1``/``ln2``/``ln_f``;
+  ``wte`` -> ``tok_embed`` (the ``attend`` path IS the tied head),
+  ``wpe`` -> ``pos_embed``.
+
+Tensors are accepted as anything ``np.asarray`` understands (torch
+tensors get ``.detach().cpu()`` first) — no hard transformers/torch
+dependency; the parity test builds a RANDOM-INIT ``GPT2LMHeadModel``
+from a config (no download, zero egress) and pins logits to 1e-4.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def _np(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu()
+        if t.is_floating_point():
+            # bfloat16/half tensors have no numpy dtype — widen first.
+            t = t.float()
+        t = t.numpy()
+    return np.asarray(t)
+
+
+def gpt2_model_config(
+    state_dict: Mapping[str, Any], num_heads: int | None = None
+) -> dict:
+    """Infer the ``TransformerLM`` constructor kwargs that match a GPT-2
+    ``state_dict`` (dims read from the tensors; conventions fixed by the
+    architecture). Pass to ``TransformerLM(**gpt2_model_config(sd))``,
+    optionally overriding ``dtype`` / ``attention_impl``.
+
+    ``num_heads`` is NOT recoverable from tensor shapes (the fused
+    ``c_attn`` is [d, 3d] for any head count); by default the GPT-2
+    family's fixed head_dim of 64 is assumed — pass ``num_heads``
+    explicitly for custom-headed configs, or the converted model will
+    silently attend with the wrong head grouping."""
+    if "transformer.h.0.ln_1.weight" not in state_dict:
+        raise ValueError(
+            "no transformer.h.{i} blocks found — not a GPT2LMHeadModel "
+            "state_dict (expected transformers' key layout)"
+        )
+    wte = _np(state_dict["transformer.wte.weight"])
+    wpe = _np(state_dict["transformer.wpe.weight"])
+    c_fc = _np(state_dict["transformer.h.0.mlp.c_fc.weight"])
+    n_layers = 0
+    while f"transformer.h.{n_layers}.ln_1.weight" in state_dict:
+        n_layers += 1
+    d_model = wte.shape[1]
+    if num_heads is None:
+        # GPT-2 family fixes head_dim = 64 (see docstring).
+        if d_model % 64:
+            raise ValueError(
+                f"d_model {d_model} is not a GPT-2-family width (expected "
+                "a multiple of the fixed head_dim 64); pass num_heads "
+                "explicitly"
+            )
+        num_heads = d_model // 64
+    elif d_model % num_heads:
+        raise ValueError(
+            f"num_heads {num_heads} does not divide d_model {d_model}"
+        )
+    return dict(
+        vocab_size=wte.shape[0],
+        num_layers=n_layers,
+        num_heads=num_heads,
+        d_model=d_model,
+        d_ff=c_fc.shape[1],
+        max_seq_len=wpe.shape[0],
+        use_rope=False,
+        tie_embeddings=True,
+        norm="layernorm",
+        mlp="gelu",
+        norm_eps=1e-5,
+        attn_bias=True,
+        attention_impl="dense",
+    )
+
+
+def lm_params_from_hf_gpt2(state_dict: Mapping[str, Any]) -> dict:
+    """Convert a ``GPT2LMHeadModel.state_dict()`` into the ``params``
+    tree of the matching ``TransformerLM`` (see ``gpt2_model_config``).
+    The tied ``lm_head.weight`` is ignored (it aliases ``wte``)."""
+    if "transformer.h.0.ln_1.weight" not in state_dict:
+        raise ValueError(
+            "no transformer.h.{i} blocks found — not a GPT2LMHeadModel "
+            "state_dict (expected transformers' key layout)"
+        )
+    params: dict = {
+        "tok_embed": {"embedding": _np(state_dict["transformer.wte.weight"])},
+        "pos_embed": {"embedding": _np(state_dict["transformer.wpe.weight"])},
+        "ln_f": {
+            "scale": _np(state_dict["transformer.ln_f.weight"]),
+            "bias": _np(state_dict["transformer.ln_f.bias"]),
+        },
+    }
+    i = 0
+    while f"transformer.h.{i}.ln_1.weight" in state_dict:
+        pre = f"transformer.h.{i}"
+        d = _np(state_dict[f"{pre}.ln_1.weight"]).shape[0]
+        ca_w = _np(state_dict[f"{pre}.attn.c_attn.weight"])  # [d, 3d]
+        ca_b = _np(state_dict[f"{pre}.attn.c_attn.bias"])  # [3d]
+        if ca_w.shape != (d, 3 * d):
+            raise ValueError(
+                f"{pre}.attn.c_attn.weight has shape {ca_w.shape}, "
+                f"expected {(d, 3 * d)} — not a GPT-2 checkpoint?"
+            )
+        params[f"block_{i}"] = {
+            "ln1": {
+                "scale": _np(state_dict[f"{pre}.ln_1.weight"]),
+                "bias": _np(state_dict[f"{pre}.ln_1.bias"]),
+            },
+            "ln2": {
+                "scale": _np(state_dict[f"{pre}.ln_2.weight"]),
+                "bias": _np(state_dict[f"{pre}.ln_2.bias"]),
+            },
+            "attn": {
+                "q": {"kernel": ca_w[:, :d], "bias": ca_b[:d]},
+                "k": {"kernel": ca_w[:, d : 2 * d], "bias": ca_b[d : 2 * d]},
+                "v": {"kernel": ca_w[:, 2 * d :], "bias": ca_b[2 * d :]},
+                "attn_out": {
+                    "kernel": _np(state_dict[f"{pre}.attn.c_proj.weight"]),
+                    "bias": _np(state_dict[f"{pre}.attn.c_proj.bias"]),
+                },
+            },
+            "mlp_in": {
+                "kernel": _np(state_dict[f"{pre}.mlp.c_fc.weight"]),
+                "bias": _np(state_dict[f"{pre}.mlp.c_fc.bias"]),
+            },
+            "mlp_out": {
+                "kernel": _np(state_dict[f"{pre}.mlp.c_proj.weight"]),
+            },
+            # This framework applies the mlp output bias AFTER the
+            # (potential) tensor psum as a separate parameter — for the
+            # unsharded import the placement is algebraically identical.
+            "mlp_out_bias": _np(state_dict[f"{pre}.mlp.c_proj.bias"]),
+        }
+        i += 1
+    return params

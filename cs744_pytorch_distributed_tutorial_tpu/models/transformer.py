@@ -49,14 +49,16 @@ NORM_IMPLS = ("layernorm", "rmsnorm")
 MLP_IMPLS = ("gelu", "swiglu")
 
 
-def _norm_cls(norm: str):
+def _norm_cls(norm: str, eps: float = 1e-6):
     """The block's normalization layer: the GPT-2-style LayerNorm
     default, or RMSNorm (no mean subtraction, no bias) — the
-    llama-family choice, cheaper on the VPU by one reduction pass."""
+    llama-family choice, cheaper on the VPU by one reduction pass.
+    ``eps`` is exposed because checkpoint families pin it (GPT-2: 1e-5,
+    flax default 1e-6) and eval-parity imports need the exact value."""
     if norm == "layernorm":
-        return nn.LayerNorm
+        return partial(nn.LayerNorm, epsilon=eps)
     if norm == "rmsnorm":
-        return nn.RMSNorm
+        return partial(nn.RMSNorm, epsilon=eps)
     raise ValueError(f"unknown norm {norm!r}; choose from {NORM_IMPLS}")
 
 
@@ -168,6 +170,11 @@ class Attention(nn.Module):
     # per-(batch, position, head) scale — the long-context decode
     # bandwidth lever, independent of quant_dense.
     quant_kv_cache: bool = False
+    # Biases on the q/k/v/attn_out projections (GPT-2 checkpoints have
+    # them; the default False matches the modern bias-free convention).
+    # Incompatible with a tensor axis: the row-parallel attn_out bias
+    # would be psum-summed tensor_axis_size times.
+    attn_bias: bool = False
 
     @nn.compact
     def __call__(
@@ -216,12 +223,19 @@ class Attention(nn.Module):
         kv_local = kv_heads // self.tensor_axis_size if tp else kv_heads
         if tp:
             x = copy_to_tp_region(x, self.tensor_axis)
+        if self.attn_bias and tp:
+            raise ValueError(
+                "attn_bias does not compose with a tensor axis (the "
+                "row-parallel attn_out bias would be summed "
+                f"{self.tensor_axis_size}x by the sublayer psum)"
+            )
+
         def proj_cls(mod):
             return _dense_cls(self.quant_dense and mod in self.quant_modules)
 
         def proj(feats, name):
             return proj_cls(name)(
-                feats, use_bias=False, dtype=self.dtype, name=name
+                feats, use_bias=self.attn_bias, dtype=self.dtype, name=name
             )
 
         q = proj(heads_local * head_dim, name="q")(x)
@@ -398,7 +412,8 @@ class Attention(nn.Module):
             )
         out = out.reshape(b, t, heads_local * head_dim).astype(self.dtype)
         out = proj_cls("attn_out")(
-            d_model, use_bias=False, dtype=self.dtype, name="attn_out"
+            d_model, use_bias=self.attn_bias, dtype=self.dtype,
+            name="attn_out",
         )(out)
         if tp:
             out = reduce_from_tp_region(out, self.tensor_axis)
@@ -442,6 +457,8 @@ class Block(nn.Module):
     # third column-parallel projection named mlp_gate).
     norm: str = "layernorm"
     mlp: str = "gelu"
+    norm_eps: float = 1e-6
+    attn_bias: bool = False
 
     @nn.compact
     def __call__(
@@ -481,7 +498,7 @@ class Block(nn.Module):
         drop = partial(
             nn.Dropout, rate=self.dropout_rate, deterministic=deterministic
         )
-        norm = partial(_norm_cls(self.norm), dtype=self.dtype)
+        norm = partial(_norm_cls(self.norm, self.norm_eps), dtype=self.dtype)
         h = norm(name="ln1")(x)
         attn_out = Attention(
             num_heads=self.num_heads,
@@ -500,6 +517,7 @@ class Block(nn.Module):
             quant_dense=self.quant_dense,
             quant_modules=self.quant_modules,
             quant_kv_cache=self.quant_kv_cache,
+            attn_bias=self.attn_bias,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos)
         if self.dropout_rate > 0.0:
@@ -625,6 +643,9 @@ class TransformerLM(nn.Module):
     # to the final norm too; swiglu adds the column-parallel mlp_gate.
     norm: str = "layernorm"
     mlp: str = "gelu"
+    norm_eps: float = 1e-6
+    # q/k/v/attn_out projection biases (GPT-2 checkpoints; no tensor axis).
+    attn_bias: bool = False
 
     @nn.compact
     def __call__(
@@ -696,6 +717,8 @@ class TransformerLM(nn.Module):
                 quant_kv_cache=self.quant_kv_cache,
                 norm=self.norm,
                 mlp=self.mlp,
+                norm_eps=self.norm_eps,
+                attn_bias=self.attn_bias,
                 name=f"block_{i}",
             )
             # remat (train-only) rejects non-array kwargs; the defaults
@@ -706,7 +729,7 @@ class TransformerLM(nn.Module):
                 x = block(x, deterministic)
             else:
                 x = block(x, mode=mode, decode_pos=decode_pos)
-        x = _norm_cls(self.norm)(dtype=self.dtype, name="ln_f")(x)
+        x = _norm_cls(self.norm, self.norm_eps)(dtype=self.dtype, name="ln_f")(x)
         if self.tie_embeddings:
             # The attend path reuses the (unquantized) embedding table —
             # quant_dense deliberately leaves it float.

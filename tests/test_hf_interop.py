@@ -1,0 +1,115 @@
+"""HF GPT-2 checkpoint import (models/hf_interop.py).
+
+Pins logit parity between an ACTUAL ``transformers`` ``GPT2LMHeadModel``
+(random-init from config — no download, zero egress) and the converted
+``TransformerLM``, plus greedy-decode agreement and config inference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM  # noqa: E402
+from cs744_pytorch_distributed_tutorial_tpu.models.hf_interop import (  # noqa: E402
+    gpt2_model_config,
+    lm_params_from_hf_gpt2,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    cfg = transformers.GPT2Config(
+        vocab_size=256,
+        n_positions=64,
+        n_embd=128,
+        n_layer=2,
+        n_head=2,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+    )
+    torch.manual_seed(11)
+    m = transformers.GPT2LMHeadModel(cfg)
+    m.eval()
+    return m
+
+
+def test_config_inference(hf_model):
+    cfg = gpt2_model_config(hf_model.state_dict())
+    assert cfg["vocab_size"] == 256
+    assert cfg["num_layers"] == 2
+    assert cfg["d_model"] == 128
+    assert cfg["num_heads"] == 2  # head_dim fixed at 64
+    assert cfg["d_ff"] == 512
+    assert cfg["max_seq_len"] == 64
+    assert cfg["tie_embeddings"] and cfg["attn_bias"]
+    assert cfg["norm_eps"] == 1e-5
+
+
+def test_logit_parity_vs_transformers(hf_model):
+    sd = hf_model.state_dict()
+    model = TransformerLM(**gpt2_model_config(sd), flash_interpret=True)
+    params = lm_params_from_hf_gpt2(sd)
+    # The converted tree must match what the model expects, exactly.
+    ref = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    assert jax.tree_util.tree_structure(ref) == jax.tree_util.tree_structure(
+        params
+    ), (jax.tree_util.tree_structure(ref), jax.tree_util.tree_structure(params))
+    tokens = np.random.default_rng(0).integers(0, 256, (2, 16))
+    logits = np.asarray(
+        model.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    )
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(logits, hf_logits, rtol=1e-4, atol=1e-4)
+
+
+def test_greedy_decode_matches_transformers_generate(hf_model):
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    sd = hf_model.state_dict()
+    model = TransformerLM(**gpt2_model_config(sd), flash_interpret=True)
+    params = lm_params_from_hf_gpt2(sd)
+    prompt = np.random.default_rng(1).integers(0, 256, (1, 8))
+    gen = make_generator(model, max_new_tokens=6, temperature=0.0)
+    ours = np.asarray(
+        gen(params, jnp.asarray(prompt, jnp.int32), jax.random.key(0))
+    )
+    with torch.no_grad():
+        hf = hf_model.generate(
+            torch.from_numpy(prompt),
+            max_new_tokens=6,
+            do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, 8:]
+    np.testing.assert_array_equal(ours, hf)
+
+
+def test_non_gpt2_state_dict_rejected():
+    with pytest.raises(ValueError, match="no transformer.h"):
+        lm_params_from_hf_gpt2({"transformer.wte.weight": np.zeros((8, 4))})
+
+
+def test_bf16_checkpoint_converts(hf_model):
+    sd = {k: v.to(torch.bfloat16) if v.is_floating_point() else v
+          for k, v in hf_model.state_dict().items()}
+    params = lm_params_from_hf_gpt2(sd)
+    assert params["tok_embed"]["embedding"].dtype == np.float32
+
+
+def test_custom_head_count_override(hf_model):
+    sd = hf_model.state_dict()
+    cfg = gpt2_model_config(sd, num_heads=4)
+    assert cfg["num_heads"] == 4
+    with pytest.raises(ValueError, match="does not divide"):
+        gpt2_model_config(sd, num_heads=3)
+    with pytest.raises(ValueError, match="no transformer.h"):
+        gpt2_model_config({"transformer.wte.weight": np.zeros((8, 4))})
