@@ -35,7 +35,9 @@ from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
 )
 from cs744_pytorch_distributed_tutorial_tpu.models.hf_interop import (
     gpt2_model_config,
+    llama_model_config,
     lm_params_from_hf_gpt2,
+    lm_params_from_hf_llama,
 )
 from cs744_pytorch_distributed_tutorial_tpu.models.torch_interop import (
     torch_state_dict_from_vgg_variables,
@@ -122,7 +124,9 @@ __all__ = [
     "resnet50",
     "tiny_cnn",
     "gpt2_model_config",
+    "llama_model_config",
     "lm_params_from_hf_gpt2",
+    "lm_params_from_hf_llama",
     "torch_state_dict_from_vgg_variables",
     "vgg_variables_from_torch_state_dict",
     "vgg11",

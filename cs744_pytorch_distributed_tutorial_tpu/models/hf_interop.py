@@ -50,6 +50,14 @@ def _np(t: Any) -> np.ndarray:
     return np.asarray(t)
 
 
+def _require_layout(state_dict: Mapping[str, Any], sentinel: str, family: str):
+    if sentinel not in state_dict:
+        raise ValueError(
+            f"no {sentinel.rsplit('.0.', 1)[0]}.{{i}} blocks found — not a "
+            f"{family} state_dict (expected transformers' key layout)"
+        )
+
+
 def gpt2_model_config(
     state_dict: Mapping[str, Any], num_heads: int | None = None
 ) -> dict:
@@ -63,11 +71,9 @@ def gpt2_model_config(
     family's fixed head_dim of 64 is assumed — pass ``num_heads``
     explicitly for custom-headed configs, or the converted model will
     silently attend with the wrong head grouping."""
-    if "transformer.h.0.ln_1.weight" not in state_dict:
-        raise ValueError(
-            "no transformer.h.{i} blocks found — not a GPT2LMHeadModel "
-            "state_dict (expected transformers' key layout)"
-        )
+    _require_layout(
+        state_dict, "transformer.h.0.ln_1.weight", "GPT2LMHeadModel"
+    )
     wte = _np(state_dict["transformer.wte.weight"])
     wpe = _np(state_dict["transformer.wpe.weight"])
     c_fc = _np(state_dict["transformer.h.0.mlp.c_fc.weight"])
@@ -109,11 +115,9 @@ def lm_params_from_hf_gpt2(state_dict: Mapping[str, Any]) -> dict:
     """Convert a ``GPT2LMHeadModel.state_dict()`` into the ``params``
     tree of the matching ``TransformerLM`` (see ``gpt2_model_config``).
     The tied ``lm_head.weight`` is ignored (it aliases ``wte``)."""
-    if "transformer.h.0.ln_1.weight" not in state_dict:
-        raise ValueError(
-            "no transformer.h.{i} blocks found — not a GPT2LMHeadModel "
-            "state_dict (expected transformers' key layout)"
-        )
+    _require_layout(
+        state_dict, "transformer.h.0.ln_1.weight", "GPT2LMHeadModel"
+    )
     params: dict = {
         "tok_embed": {"embedding": _np(state_dict["transformer.wte.weight"])},
         "pos_embed": {"embedding": _np(state_dict["transformer.wpe.weight"])},
@@ -162,6 +166,118 @@ def lm_params_from_hf_gpt2(state_dict: Mapping[str, Any]) -> dict:
             # (potential) tensor psum as a separate parameter — for the
             # unsharded import the placement is algebraically identical.
             "mlp_out_bias": _np(state_dict[f"{pre}.mlp.c_proj.bias"]),
+        }
+        i += 1
+    return params
+
+
+def llama_model_config(
+    state_dict: Mapping[str, Any],
+    num_heads: int,
+    max_seq_len: int = 2048,
+    rope_base: float = 10000.0,
+    rms_norm_eps: float = 1e-6,
+) -> dict:
+    """``TransformerLM`` kwargs matching a ``transformers``
+    ``LlamaForCausalLM`` ``state_dict``: RMSNorm + SwiGLU + RoPE + GQA —
+    every piece maps onto this framework's llama-family block options.
+
+    ``num_heads`` is required (llama head_dim is not recoverable from
+    tensor shapes; the KV head count IS derived — from the k_proj
+    width). ``max_seq_len``, ``rope_base`` and ``rms_norm_eps`` come
+    from the HF config (``max_position_embeddings`` / ``rope_theta`` /
+    ``rms_norm_eps``; the 1e-6 default here matches LlamaConfig's), not
+    the weights. Tied-embedding checkpoints (no ``lm_head.weight`` —
+    safetensors drops tensors shared with ``embed_tokens``) come out
+    with ``tie_embeddings=True``."""
+    _require_layout(
+        state_dict, "model.layers.0.input_layernorm.weight",
+        "LlamaForCausalLM",
+    )
+    embed = _np(state_dict["model.embed_tokens.weight"])
+    d_model = embed.shape[1]
+    if d_model % num_heads:
+        raise ValueError(
+            f"num_heads {num_heads} does not divide d_model {d_model}"
+        )
+    head_dim = d_model // num_heads
+    kv_width = _np(state_dict["model.layers.0.self_attn.k_proj.weight"]).shape[0]
+    if kv_width % head_dim:
+        raise ValueError(
+            f"k_proj width {kv_width} is not a multiple of head_dim "
+            f"{head_dim} (d_model {d_model} / num_heads {num_heads}) — "
+            "wrong num_heads?"
+        )
+    d_ff = _np(state_dict["model.layers.0.mlp.gate_proj.weight"]).shape[0]
+    n_layers = 0
+    while f"model.layers.{n_layers}.input_layernorm.weight" in state_dict:
+        n_layers += 1
+    return dict(
+        vocab_size=embed.shape[0],
+        num_layers=n_layers,
+        num_heads=num_heads,
+        num_kv_heads=kv_width // head_dim,
+        d_model=d_model,
+        d_ff=d_ff,
+        max_seq_len=max_seq_len,
+        use_rope=True,
+        rope_base=rope_base,
+        tie_embeddings="lm_head.weight" not in state_dict,
+        norm="rmsnorm",
+        mlp="swiglu",
+        norm_eps=rms_norm_eps,
+        attn_bias=False,
+        attention_impl="dense",
+    )
+
+
+def lm_params_from_hf_llama(state_dict: Mapping[str, Any]) -> dict:
+    """Convert a ``LlamaForCausalLM.state_dict()`` into the ``params``
+    tree of the matching ``TransformerLM`` (``llama_model_config``).
+    torch ``Linear`` weights are [out, in] and transpose to the flax
+    [in, out] kernel; llama has no projection biases, but this
+    framework's ``mlp_in`` bias and post-psum ``mlp_out_bias`` always
+    exist — they are zero-filled (numerically identical)."""
+    _require_layout(
+        state_dict, "model.layers.0.input_layernorm.weight",
+        "LlamaForCausalLM",
+    )
+    params: dict = {
+        "tok_embed": {"embedding": _np(state_dict["model.embed_tokens.weight"])},
+        "ln_f": {"scale": _np(state_dict["model.norm.weight"])},
+    }
+    if "lm_head.weight" in state_dict:
+        params["lm_head"] = {"kernel": _np(state_dict["lm_head.weight"]).T}
+    # else: tied embeddings — the model's attend path reuses tok_embed.
+    i = 0
+    while f"model.layers.{i}.input_layernorm.weight" in state_dict:
+        pre = f"model.layers.{i}"
+
+        def lin(name: str) -> np.ndarray:
+            return _np(state_dict[f"{pre}.{name}.weight"]).T  # [out,in]->[in,out]
+
+        gate = lin("mlp.gate_proj")
+        d_model, d_ff = gate.shape
+        params[f"block_{i}"] = {
+            "ln1": {"scale": _np(state_dict[f"{pre}.input_layernorm.weight"])},
+            "ln2": {
+                "scale": _np(
+                    state_dict[f"{pre}.post_attention_layernorm.weight"]
+                )
+            },
+            "attn": {
+                "q": {"kernel": lin("self_attn.q_proj")},
+                "k": {"kernel": lin("self_attn.k_proj")},
+                "v": {"kernel": lin("self_attn.v_proj")},
+                "attn_out": {"kernel": lin("self_attn.o_proj")},
+            },
+            "mlp_gate": {"kernel": gate},
+            "mlp_in": {
+                "kernel": lin("mlp.up_proj"),
+                "bias": np.zeros(d_ff, np.float32),
+            },
+            "mlp_out": {"kernel": lin("mlp.down_proj")},
+            "mlp_out_bias": np.zeros(d_model, np.float32),
         }
         i += 1
     return params

@@ -113,3 +113,108 @@ def test_custom_head_count_override(hf_model):
         gpt2_model_config(sd, num_heads=3)
     with pytest.raises(ValueError, match="no transformer.h"):
         gpt2_model_config({"transformer.wte.weight": np.zeros((8, 4))})
+
+
+from cs744_pytorch_distributed_tutorial_tpu.models.hf_interop import (  # noqa: E402
+    llama_model_config,
+    lm_params_from_hf_llama,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(13)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_llama_config_inference(hf_llama):
+    cfg = llama_model_config(
+        hf_llama.state_dict(), num_heads=4, max_seq_len=64
+    )
+    assert cfg["vocab_size"] == 128 and cfg["d_model"] == 64
+    assert cfg["num_layers"] == 2 and cfg["num_kv_heads"] == 2
+    assert cfg["d_ff"] == 128
+    assert cfg["norm"] == "rmsnorm" and cfg["mlp"] == "swiglu"
+    assert cfg["use_rope"] and not cfg["tie_embeddings"]
+    with pytest.raises(ValueError, match="wrong num_heads"):
+        llama_model_config(hf_llama.state_dict(), num_heads=1)
+    with pytest.raises(ValueError, match="no model.layers"):
+        llama_model_config({"model.embed_tokens.weight": np.zeros((4, 4))},
+                           num_heads=2)
+
+
+def test_llama_logit_parity_vs_transformers(hf_llama):
+    sd = hf_llama.state_dict()
+    model = TransformerLM(
+        **llama_model_config(sd, num_heads=4, max_seq_len=64),
+        flash_interpret=True,
+    )
+    params = lm_params_from_hf_llama(sd)
+    ref = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    assert jax.tree_util.tree_structure(ref) == jax.tree_util.tree_structure(
+        params
+    )
+    tokens = np.random.default_rng(2).integers(0, 128, (2, 16))
+    logits = np.asarray(
+        model.apply({"params": params}, jnp.asarray(tokens, jnp.int32))
+    )
+    with torch.no_grad():
+        hf_logits = hf_llama(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(logits, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_tied_embeddings_checkpoint(hf_llama):
+    # safetensors drops tensors shared with embed_tokens: simulate a
+    # tied checkpoint by removing lm_head.weight.
+    sd = {k: v for k, v in hf_llama.state_dict().items()
+          if k != "lm_head.weight"}
+    cfg = llama_model_config(sd, num_heads=4, max_seq_len=64)
+    assert cfg["tie_embeddings"] is True
+    params = lm_params_from_hf_llama(sd)
+    assert "lm_head" not in params
+    model = TransformerLM(**cfg, flash_interpret=True)
+    ref = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"
+    ]
+    assert jax.tree_util.tree_structure(ref) == jax.tree_util.tree_structure(
+        params
+    )
+
+
+def test_llama_greedy_decode_matches_transformers(hf_llama):
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    sd = hf_llama.state_dict()
+    model = TransformerLM(
+        **llama_model_config(sd, num_heads=4, max_seq_len=64),
+        flash_interpret=True,
+    )
+    params = lm_params_from_hf_llama(sd)
+    prompt = np.random.default_rng(3).integers(0, 128, (1, 8))
+    gen = make_generator(model, max_new_tokens=6, temperature=0.0)
+    ours = np.asarray(
+        gen(params, jnp.asarray(prompt, jnp.int32), jax.random.key(0))
+    )
+    with torch.no_grad():
+        hf = hf_llama.generate(
+            torch.from_numpy(prompt),
+            max_new_tokens=6,
+            do_sample=False,
+            pad_token_id=0,
+        ).numpy()[:, 8:]
+    np.testing.assert_array_equal(ours, hf)
