@@ -1,0 +1,143 @@
+"""Speculative decoding on the real chip: trained byte-LM draft+target.
+
+Measures what `infer/speculative.py` buys in the regime bench_generate
+pinned as OP-LATENCY-bound: batch-1 greedy decoding, where the serial
+per-token chain (not bandwidth or FLOPs) sets wall-clock. A 4-layer
+target and a 1-layer draft train briefly on this repo's own README as a
+byte corpus (enough for real draft/target agreement — random drafts
+accept ~nothing and measure only overhead), then tokens/sec and the
+realized acceptance are measured for plain greedy vs speculative at
+several k.
+
+Timing: whole generations are single dispatches (the entire
+draft-propose/verify loop is one jitted while_loop), batched CALLS-deep
+with one fence — same RTT-amortization as bench_generate.
+
+Run: python benchmarks/bench_speculative.py
+
+Measured 2026-07-31 (one TPU v5e chip, trained byte-LMs, device time
+from the trace; both models reach ~0 train loss and teacher-forced
+draft/target agreement 1.00 on the generated text):
+  plain greedy      12.6 ms/gen   20.3k tok/s
+  speculative k=2    5.5 ms/gen   47.0k tok/s  (2.31x)  acceptance ~1.0
+  speculative k=4    4.9 ms/gen   52.5k tok/s  (2.58x)  acceptance 1.00
+  speculative k=8    4.6 ms/gen   55.7k tok/s  (2.74x)  acceptance 0.98
+Target forwards drop 256 -> 29 at k=8 (8.8x); the draft's own serial
+steps bound the remaining time. An earlier version of the decoder
+measured only ~0.83 acceptance on this same agreement-1.00 pair — the
+draft cache row at pos+k was never written (found in review, fixed,
+and the strict self-draft stats test now pins it). Earlier wall-clock
+attempts measured 0.4-0.9x "slowdowns" that were pure tunnel weather —
+RTT swung 3-500 ms in-session; the trace is ground truth. A random
+(untrained-agreement) draft costs ~3x plain in device time at k=8 —
+speculation must be earned by a draft that actually agrees.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from cs744_pytorch_distributed_tutorial_tpu.data import byte_corpus
+from cs744_pytorch_distributed_tutorial_tpu.infer import (
+    make_generator,
+    make_speculative_generator,
+)
+from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+SEQ = 512
+MAX_SEQ = 1024
+PROMPT = 128
+NEW = 256
+STEPS = 800
+CALLS = 6
+ROUNDS = 3
+
+
+def train(num_layers: int, d_model: int, d_ff: int, tokens):
+    cfg = LMConfig(
+        vocab_size=256,
+        num_layers=num_layers,
+        num_heads=4,
+        d_model=d_model,
+        d_ff=d_ff,
+        max_seq_len=MAX_SEQ,
+        seq_len=SEQ,
+        attention_impl="dense",
+        compute_dtype="bfloat16",
+        use_rope=True,
+        global_batch_size=8,
+        learning_rate=1e-3,
+        lr_schedule="warmup_cosine",
+        warmup_steps=50,
+        total_steps=STEPS,
+        optimizer="adamw",
+    )
+    tr = LMTrainer(cfg)
+    params, _, losses = tr.fit(tokens, STEPS)
+    return tr, jax.device_get(params), losses[-1]
+
+
+def timed(gen, *args) -> float:
+    """DEVICE time per generation from the profiler trace — the tunnel's
+    round-trip latency has been observed anywhere from 3 to 500 ms in a
+    single session, and even pipelined-dispatch wall timing drowns at
+    the upper end; the trace is ground truth (see utils/profiling.py)."""
+    from cs744_pytorch_distributed_tutorial_tpu.utils.profiling import (
+        device_op_breakdown,
+    )
+
+    out = gen(*args)
+    float(jax.tree.leaves(out)[0].ravel()[0])
+    total, _ = device_op_breakdown(gen, *args, iters=3, top=1)
+    return total / 1e3
+
+
+def main() -> None:
+    corpus = byte_corpus("README.md", SEQ, max_seqs=512, seed=0)
+    target_tr, tp, tl = train(4, 256, 1024, corpus)
+    draft_tr, dp, dl = train(1, 256, 1024, corpus)
+    print(f"trained: target 4L/256d loss {tl:.3f}, draft 1L/256d loss {dl:.3f}")
+
+    prompt = jnp.asarray(corpus[:1, :PROMPT], jnp.int32)
+    target = target_tr.decode_model()
+    draft = draft_tr.decode_model()
+
+    plain = make_generator(target, max_new_tokens=NEW, temperature=0.0)
+    key = jax.random.key(0)
+    base = min(timed(plain, tp, prompt, key) for _ in range(ROUNDS))
+    # Diagnostic upper bound on acceptance: teacher-forced agreement of
+    # the draft with the target's own greedy continuation.
+    t_out = plain(tp, prompt, key)
+    seq = jnp.concatenate([prompt, t_out.astype(jnp.int32)], axis=1)
+    d_logits = draft.apply({"params": dp}, seq)
+    d_pred = jnp.argmax(d_logits[:, PROMPT - 1 : -1], axis=-1)
+    agree = float((d_pred == t_out).mean())
+    print(f"teacher-forced draft/target agreement: {agree:.2f}")
+    print(
+        f"plain greedy          {base * 1e3:7.1f} ms/gen  "
+        f"{NEW / base:8.0f} tok/s"
+    )
+    for k in (2, 4, 8):
+        spec = make_speculative_generator(
+            target, draft, max_new_tokens=NEW, k=k, return_stats=True
+        )
+        dt = min(timed(spec, tp, dp, prompt) for _ in range(ROUNDS))
+        _, calls = spec(tp, dp, prompt)
+        calls = int(calls)
+        accept = (NEW / max(calls, 1) - 1) / k
+        print(
+            f"speculative k={k}       {dt * 1e3:7.1f} ms/gen  "
+            f"{NEW / dt:8.0f} tok/s  ({base / dt:.2f}x)  "
+            f"[{calls} target calls, acceptance {accept:.2f}]"
+        )
+
+
+if __name__ == "__main__":
+    main()

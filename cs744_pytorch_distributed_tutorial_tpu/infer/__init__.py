@@ -3,5 +3,13 @@ from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
     make_generator,
     sample_tokens,
 )
+from cs744_pytorch_distributed_tutorial_tpu.infer.speculative import (
+    make_speculative_generator,
+)
 
-__all__ = ["make_beam_searcher", "make_generator", "sample_tokens"]
+__all__ = [
+    "make_beam_searcher",
+    "make_generator",
+    "make_speculative_generator",
+    "sample_tokens",
+]

@@ -157,6 +157,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "with --int8-decode")
     p.add_argument("--beam", type=int, default=0, metavar="K",
                    help="beam-search decode with K beams instead of sampling")
+    p.add_argument("--speculative-k", type=int, default=0, metavar="K",
+                   help="speculative greedy decoding: train a shallow "
+                        "draft on the same data, propose K tokens per "
+                        "target verification chunk "
+                        "(infer/speculative.py; needs --temperature 0, "
+                        "no --beam)")
+    p.add_argument("--draft-layers", type=int, default=1,
+                   help="layer count of the speculative draft model "
+                        "(same width/heads as the target)")
     p.add_argument("--json", action="store_true")
     return p
 
@@ -461,7 +470,42 @@ def main(argv: list[str] | None = None) -> int:
             decode_model = trainer.decode_model().clone(quant_kv_cache=True)
         else:
             decode_model = trainer.decode_model()
-        if args.beam > 0:
+        if args.speculative_k > 0:
+            # Greedy-only, incompatible with beam/sampling/int8 (the
+            # draft shares the float decode path).
+            if args.beam > 0 or args.temperature != 0.0:
+                raise SystemExit(
+                    "--speculative-k is greedy decoding: needs "
+                    "--temperature 0 and no --beam"
+                )
+            if args.int8_decode is not None or args.int8_kv_cache:
+                raise SystemExit(
+                    "--speculative-k does not combine with the int8 decode "
+                    "paths (verify in float; quantize separately)"
+                )
+            import dataclasses
+
+            from cs744_pytorch_distributed_tutorial_tpu.infer import (
+                make_speculative_generator,
+            )
+
+            # Shallow draft: same width/heads/vocab, fewer layers,
+            # trained on the same data stream.
+            draft_cfg = dataclasses.replace(
+                trainer.cfg, num_layers=args.draft_layers
+            )
+            draft_tr = LMTrainer(draft_cfg)
+            draft_params, _, _ = draft_tr.fit(tokens, args.steps)
+            spec = make_speculative_generator(
+                decode_model,
+                draft_tr.decode_model(),
+                max_new_tokens=args.generate,
+                k=args.speculative_k,
+            )
+            out = spec(
+                host_params, jax.device_get(draft_params), prompt_arr[:1]
+            )
+        elif args.beam > 0:
             from cs744_pytorch_distributed_tutorial_tpu.infer import (
                 make_beam_searcher,
             )

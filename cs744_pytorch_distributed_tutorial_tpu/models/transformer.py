@@ -338,11 +338,11 @@ class Attention(nn.Module):
             else:
                 if decode_pos is None:
                     raise ValueError("mode='decode' needs decode_pos")
-                if t != 1:
-                    raise ValueError(
-                        f"mode='decode' is a single-token step, got t={t}; "
-                        "feed multi-token chunks through mode='prefill'"
-                    )
+                # t == 1 is the classic decode step; t > 1 is a chunk at
+                # positions decode_pos..decode_pos+t-1 attending over the
+                # cache with per-row causal masking (chunked prefill /
+                # speculative verification — decode_attention handles
+                # both shapes).
                 write_cache(decode_pos)
                 decode_step = True
 

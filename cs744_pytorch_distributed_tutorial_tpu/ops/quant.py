@@ -245,12 +245,12 @@ def decode_attention_quant(
     into the probabilities before the PV contraction. Positions > ``pos``
     are masked exactly as in the float variant.
     """
-    b, one, hq, d = q.shape
+    b, t, hq, d = q.shape
     hkv = cached_k.shape[2]
     if hq % hkv:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
     group = hq // hkv
-    qg = q.reshape(b, one, hkv, group, d)
+    qg = q.reshape(b, t, hkv, group, d)
     scale = d**-0.5
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk",
@@ -261,13 +261,16 @@ def decode_attention_quant(
     scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     neg = jnp.float32(-1e30)
     k_pos = jnp.arange(cached_k.shape[1])
-    scores = jnp.where(k_pos[None, None, None, None, :] <= pos, scores, neg)
+    # Chunk rows sit at positions pos..pos+t-1 (see the float variant).
+    q_pos = pos + jnp.arange(t)
+    mask = k_pos[None, :] <= q_pos[:, None]
+    scores = jnp.where(mask[None, None, None, :, :], scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
     pv = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", pv, cached_v.astype(jnp.float32)
     )
-    return out.reshape(b, one, hq, d).astype(q.dtype)
+    return out.reshape(b, t, hq, d).astype(q.dtype)
 
 
 # All TransformerLM Dense modules whose kernels CAN quantize (embeddings

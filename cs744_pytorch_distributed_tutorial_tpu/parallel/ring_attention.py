@@ -74,36 +74,42 @@ def decode_attention(
     cached_v: jax.Array,
     pos: jax.Array,
 ) -> jax.Array:
-    """One autoregressive decode step against a KV cache.
+    """Autoregressive decode step(s) against a KV cache.
 
-    ``q`` is [B, 1, Hq, D] (the new token's query); ``cached_k``/
-    ``cached_v`` are [B, L, Hkv, D] caches whose entries at positions >
-    ``pos`` (the new token's global position) are unwritten garbage —
-    masked out here, so softmax weights for them are exactly 0.0 and the
-    result matches ``dense_attention`` over the first ``pos+1`` positions.
-    ``Hq`` may be a multiple of ``Hkv`` (grouped-query attention): query
-    heads group over the shared KV heads directly in the einsums — the
-    cache is never materialized at query-head width, which is GQA's
-    decode-bandwidth saving. Same numerics discipline as the other
-    variants: float32 scores/softmax, PV matmul in the cache dtype.
+    ``q`` is [B, T, Hq, D] — T == 1 is the classic single-token decode
+    step; T > 1 is a CHUNK whose row i sits at global position
+    ``pos + i`` (chunked prefill, and the verification pass of
+    speculative decoding — ``infer/speculative.py``). ``cached_k``/
+    ``cached_v`` are [B, L, Hkv, D] caches whose entries at positions
+    beyond each row's own position are unwritten garbage or future
+    tokens — masked per row (``k_pos <= pos + i``), so softmax weights
+    for them are exactly 0.0 and each row matches ``dense_attention``
+    over its visible prefix. ``Hq`` may be a multiple of ``Hkv``
+    (grouped-query attention): query heads group over the shared KV
+    heads directly in the einsums — the cache is never materialized at
+    query-head width, which is GQA's decode-bandwidth saving. Same
+    numerics discipline as the other variants: float32 scores/softmax,
+    PV matmul in the cache dtype.
     """
-    b, one, hq, d = q.shape
+    b, t, hq, d = q.shape
     hkv = cached_k.shape[2]
     if hq % hkv:
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
     group = hq // hkv
-    qg = q.reshape(b, one, hkv, group, d)
+    qg = q.reshape(b, t, hkv, group, d)
     scale = d**-0.5
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk", qg, cached_k, preferred_element_type=jnp.float32
     ) * scale
     k_pos = jnp.arange(cached_k.shape[1])
-    scores = jnp.where(k_pos[None, None, None, None, :] <= pos, scores, _MASK)
+    q_pos = pos + jnp.arange(t)
+    mask = k_pos[None, :] <= q_pos[:, None]  # [t, L]
+    scores = jnp.where(mask[None, None, None, :, :], scores, _MASK)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", probs.astype(cached_v.dtype), cached_v,
     )
-    return out.reshape(b, one, hq, d)
+    return out.reshape(b, t, hq, d)
 
 
 def _kv_group(q, k):

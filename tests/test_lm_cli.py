@@ -157,3 +157,20 @@ def test_lm_cli_llama_options_both_engines(capsys):
     assert rc == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert summary["engine"] == "pipeline" and summary["finite"]
+
+
+def test_lm_cli_speculative_decode(capsys):
+    rc = main(TINY + [
+        "--vocab-size", "32", "--generate", "6", "--prompt-len", "4",
+        "--temperature", "0", "--speculative-k", "2", "--draft-layers", "1",
+        "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(summary["sample"]) == 6
+    # greedy-only guard
+    with pytest.raises(SystemExit):
+        main(TINY + [
+            "--vocab-size", "32", "--generate", "4", "--speculative-k", "2",
+            "--temperature", "0.8",
+        ])

@@ -1,0 +1,152 @@
+"""Speculative decoding (infer/speculative.py).
+
+The load-bearing property: greedy speculative output is BIT-IDENTICAL
+to plain greedy decoding of the target alone, for ANY draft — a random
+draft (worst case, near-zero acceptance) and the target itself as draft
+(acceptance 1) must both reproduce ``make_generator(temperature=0)``
+exactly. Plus chunked-decode logit parity (the ``decode_attention``
+T>1 path the verifier rides) and guard-rail rejections.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+from cs744_pytorch_distributed_tutorial_tpu.infer.speculative import (
+    make_speculative_generator,
+)
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+
+
+def _model(layers=2, seed_dims=True, **kw) -> TransformerLM:
+    base = dict(
+        vocab_size=64,
+        num_layers=layers,
+        num_heads=4,
+        num_kv_heads=2,
+        d_model=64,
+        d_ff=128,
+        max_seq_len=64,
+        dtype=jnp.float32,
+        attention_impl="dense",
+        use_rope=True,
+        flash_interpret=True,
+    )
+    base.update(kw)
+    return TransformerLM(**base)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    target = _model(2)
+    draft = _model(1)
+    prompt = jax.random.randint(jax.random.key(0), (1, 8), 0, 64)
+    tp = target.init(jax.random.key(1), prompt)["params"]
+    dp = draft.init(jax.random.key(2), prompt)["params"]
+    plain = make_generator(target, max_new_tokens=12, temperature=0.0)
+    want = np.asarray(plain(tp, prompt, jax.random.key(3)))
+    return target, draft, prompt, tp, dp, want
+
+
+def test_chunked_decode_matches_teacher_forcing():
+    """mode='decode' with T>1 (the verification pass) must reproduce the
+    full teacher-forced forward at every chunk row."""
+    model = _model(2)
+    tokens = jax.random.randint(jax.random.key(4), (1, 16), 0, 64)
+    params = model.init(jax.random.key(5), tokens)["params"]
+    full = np.asarray(model.apply({"params": params}, tokens))
+    # Prefill the first 8, then feed positions 8..15 as ONE chunk.
+    _, vars_ = model.apply(
+        {"params": params}, tokens[:, :8], mode="prefill", mutable=["cache"]
+    )
+    chunk_logits, _ = model.apply(
+        {"params": params, "cache": vars_["cache"]},
+        tokens[:, 8:],
+        mode="decode",
+        decode_pos=jnp.asarray(8, jnp.int32),
+        mutable=["cache"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunk_logits), full[:, 8:], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_exact_parity_with_random_draft(setup):
+    target, draft, prompt, tp, dp, want = setup
+    spec = make_speculative_generator(
+        target, draft, max_new_tokens=12, k=3
+    )
+    got = np.asarray(spec(tp, dp, prompt))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_exact_parity_with_self_draft(setup):
+    """Target as its own draft: acceptance is 1 by construction and the
+    output must still be exactly plain greedy."""
+    target, _, prompt, tp, _, want = setup
+    spec = make_speculative_generator(
+        target, target, max_new_tokens=12, k=4
+    )
+    got = np.asarray(spec(tp, tp, prompt))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("k", [1, 2, 5])
+def test_exact_parity_across_k(setup, k):
+    target, draft, prompt, tp, dp, want = setup
+    spec = make_speculative_generator(target, draft, max_new_tokens=12, k=k)
+    np.testing.assert_array_equal(np.asarray(spec(tp, dp, prompt)), want)
+
+
+def test_eos_masks_tail(setup):
+    target, draft, prompt, tp, dp, want = setup
+    eos = int(want[0, 4])  # force an 'EOS' at a known emitted position
+    spec = make_speculative_generator(
+        target, draft, max_new_tokens=12, k=3, eos_id=eos, pad_id=0
+    )
+    got = np.asarray(spec(tp, dp, prompt))[0]
+    first = int(np.argmax(got == eos))
+    assert got[first] == eos
+    assert (got[first + 1 :] == 0).all()
+
+
+def test_guard_rails(setup):
+    target, draft, prompt, tp, dp, _ = setup
+    with pytest.raises(ValueError, match="k must be"):
+        make_speculative_generator(target, draft, max_new_tokens=4, k=0)
+    with pytest.raises(ValueError, match="vocab"):
+        make_speculative_generator(
+            target, draft.clone(vocab_size=32), max_new_tokens=4
+        )
+    spec = make_speculative_generator(target, draft, max_new_tokens=4, k=2)
+    with pytest.raises(ValueError, match="batch-1"):
+        spec(tp, dp, jnp.zeros((2, 8), jnp.int32))
+    with pytest.raises(ValueError, match="exceeds"):
+        make_speculative_generator(target, draft, max_new_tokens=60, k=4)(
+            tp, dp, prompt
+        )
+
+
+def test_stats_counts_target_calls(setup):
+    target, draft, prompt, tp, dp, want = setup
+    spec = make_speculative_generator(
+        target, target, max_new_tokens=12, k=3, return_stats=True
+    )
+    toks, iters = spec(tp, tp, prompt)
+    np.testing.assert_array_equal(np.asarray(toks), want)
+    # Self-draft accepts all k proposals every call (each call emits
+    # k+1 = 4 tokens past the free prefill token): ceil(11/4) = 3.
+    # This pins the draft-cache completeness fix — the missing pos+k
+    # row used to cost an extra call here.
+    assert int(iters) == 3, int(iters)
+    # A (worst-case) random draft can never need more than one call per
+    # emitted token after the free prefill token.
+    specr = make_speculative_generator(
+        target, draft, max_new_tokens=12, k=3, return_stats=True
+    )
+    _, iters_r = specr(tp, dp, prompt)
+    assert 3 <= int(iters_r) <= 11
