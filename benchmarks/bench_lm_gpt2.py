@@ -25,6 +25,13 @@ batch 16) — its value is the absent [N, V] log-softmax buffer when
 memory binds, and its off-by-default is now measured, not assumed
 (table + discussion in benchmarks/README.md).
 
+Remat ablation (measured): at batch 8 the activations FIT without
+remat, and turning it off buys the dots-policy recompute back:
+  flash + remat=dots  84.5 ms/step   96.9k tok/s  MFU 0.421
+  flash + remat=off   78.3 ms/step  104.6k tok/s  MFU 0.454  (+8%)
+The headline when memory allows is remat=off; remat remains the
+long-context/major-batch memory lever it was built as.
+
 Batch scaling (measured, negative): flash at batch 16 is 94.5k tok/s
 (MFU 0.41 — no better than batch 8; the d768 matmuls are already
 MXU-shaped), and batch 32 fails to compile through the tunnel's remote
@@ -74,7 +81,7 @@ def gpt2ish_train_flops_per_token() -> float:
     return 3.0 * fwd
 
 
-def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH) -> dict:
+def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH, remat: bool = True) -> dict:
     cfg = LMConfig(
         vocab_size=VOCAB,
         num_layers=LAYERS,
@@ -86,8 +93,8 @@ def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH) -> d
         global_batch_size=batch,
         attention_impl=attention_impl,
         compute_dtype="bfloat16",
-        remat=True,
-        remat_policy="dots",
+        remat=remat,
+        remat_policy="dots" if remat else "none",
         use_rope=True,
         fused_xent=fused_xent,
     )
@@ -122,7 +129,7 @@ def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH) -> d
             else None
         ),
         "config": f"{LAYERS}L/{D_MODEL}d/{HEADS}h/T{SEQ}/V{VOCAB}"
-                  f"/b{batch}/bf16/remat=dots/rope",
+                  f"/b{batch}/bf16/remat={'dots' if remat else 'off'}/rope",
     }
 
 
@@ -139,6 +146,11 @@ def main() -> None:
     # buffer alone is ~6.6 GB — the regime fused_xent's absent [N, V]
     # log-softmax buffer targets, so it is ablated again here where its
     # memory saving (not wall-clock) is the question.
+    # Remat ablation: at batch 8 the activations FIT without remat —
+    # measures what the dots-policy recompute costs when memory allows
+    # turning it off.
+    print(json.dumps(bench_config("flash", False, BATCH, remat=False)),
+          flush=True)
     for batch, fused in ((16, False), (32, False), (32, True)):
         try:
             print(json.dumps(bench_config("flash", fused, batch)), flush=True)
