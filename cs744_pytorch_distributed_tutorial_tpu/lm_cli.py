@@ -54,6 +54,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat-policy", default="none", choices=["none", "dots"],
                    help="remat granularity: recompute everything, or keep "
                         "matmul outputs and recompute elementwise only")
+    p.add_argument("--scan-layers", action="store_true",
+                   help="run the homogeneous blocks as one nn.scan body "
+                        "instead of L unrolled copies — identical numerics, "
+                        "O(L) smaller traced program (the compile-wall "
+                        "lever for deep/big-batch configs); params carry a "
+                        "leading layer axis")
     p.add_argument("--tie-embeddings", action="store_true",
                    help="share the token embedding with the output head")
     p.add_argument("--norm", default="layernorm",
@@ -380,6 +386,14 @@ def main(argv: list[str] | None = None) -> int:
             "(virtual stages interleave over the pipe axis)"
         )
     if args.pipeline_parallel > 1:
+        if args.scan_layers:
+            # The pipeline engine already stacks its per-stage blocks
+            # under a scan — the flag would be silently ignored.
+            raise SystemExit(
+                "--scan-layers is the shard_map engine's compile lever; "
+                "the pipeline engine already runs stacked stages (drop "
+                "--scan-layers or --pipeline-parallel)"
+            )
         return _run_pipeline(args, tokens, vocab)
 
     cfg = LMConfig(
@@ -394,6 +408,7 @@ def main(argv: list[str] | None = None) -> int:
         compute_dtype=args.compute_dtype,
         remat=args.remat,
         remat_policy=args.remat_policy,
+        scan_layers=args.scan_layers,
         tie_embeddings=args.tie_embeddings,
         use_rope=args.use_rope,
         norm=args.norm,

@@ -23,7 +23,9 @@ from cs744_pytorch_distributed_tutorial_tpu.models.resnet import (
 from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN, moe_aux_loss
 from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
     TransformerLM,
+    stack_block_params,
     transformer_lm,
+    unstack_block_params,
 )
 from cs744_pytorch_distributed_tutorial_tpu.models.vgg import (
     VGG,
@@ -114,6 +116,8 @@ __all__ = [
     "TinyCNN",
     "TransformerLM",
     "transformer_lm",
+    "stack_block_params",
+    "unstack_block_params",
     "ViT",
     "vit_small",
     "vit_tiny",

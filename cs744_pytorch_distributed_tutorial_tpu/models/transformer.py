@@ -646,6 +646,22 @@ class TransformerLM(nn.Module):
     norm_eps: float = 1e-6
     # q/k/v/attn_out projection biases (GPT-2 checkpoints; no tensor axis).
     attn_bias: bool = False
+    # Layer stacking: run the homogeneous blocks as ONE block scanned
+    # over a leading layer dimension (``nn.scan``) instead of unrolling
+    # ``num_layers`` copies into the traced program. Numerics are
+    # identical (parity pinned in tests/test_scan_layers.py); what
+    # changes is PROGRAM SIZE — the XLA input is one block body + a loop,
+    # not L inlined bodies, which is what makes deep/big-batch configs
+    # compile where the unrolled program hits compile walls (the round-3
+    # b32 remote-compile failure, benchmarks/README.md). Params (and the
+    # decode cache) carry a leading ``[num_layers]`` axis under module
+    # name "blocks"; convert to/from the unrolled layout with
+    # ``stack_block_params`` / ``unstack_block_params``. Composes with
+    # remat (the scanned body is checkpointed per layer — the classic
+    # scan-over-remat memory profile). MoE is excluded: stacking would
+    # silently change the sown aux-loss reduction, and routed blocks are
+    # the pipeline engine's domain.
+    scan_layers: bool = False
 
     @nn.compact
     def __call__(
@@ -690,45 +706,84 @@ class TransformerLM(nn.Module):
             )
         else:
             block_cls = Block
-        for i in range(self.num_layers):
-            block = block_cls(
-                num_heads=self.num_heads,
-                d_ff=self.d_ff,
-                dtype=self.dtype,
-                impl=self.attention_impl,
-                seq_axis=self.seq_axis,
-                seq_axis_size=self.seq_axis_size,
-                tensor_axis=self.tensor_axis,
-                tensor_axis_size=self.tensor_axis_size,
-                causal=self.causal,
-                flash_interpret=self.flash_interpret,
-                num_experts=self.num_experts,
-                moe_top_k=self.moe_top_k,
-                moe_capacity_factor=self.moe_capacity_factor,
-                expert_axis=self.expert_axis,
-                expert_axis_size=self.expert_axis_size,
-                max_decode_len=self.max_seq_len,
-                rope=self.use_rope,
-                rope_base=self.rope_base,
-                num_kv_heads=self.num_kv_heads,
-                dropout_rate=self.dropout_rate,
-                quant_dense=self.quant_dense,
-                quant_modules=self.quant_modules,
-                quant_kv_cache=self.quant_kv_cache,
-                norm=self.norm,
-                mlp=self.mlp,
-                norm_eps=self.norm_eps,
-                attn_bias=self.attn_bias,
-                name=f"block_{i}",
-            )
-            # remat (train-only) rejects non-array kwargs; the defaults
-            # ARE train mode, so pass the decode kwargs only off of it.
-            # ``deterministic`` rides positionally so the remat
-            # static_argnums above keeps it a Python bool.
-            if mode == "train":
-                x = block(x, deterministic)
-            else:
-                x = block(x, mode=mode, decode_pos=decode_pos)
+        block_kw = dict(
+            num_heads=self.num_heads,
+            d_ff=self.d_ff,
+            dtype=self.dtype,
+            impl=self.attention_impl,
+            seq_axis=self.seq_axis,
+            seq_axis_size=self.seq_axis_size,
+            tensor_axis=self.tensor_axis,
+            tensor_axis_size=self.tensor_axis_size,
+            causal=self.causal,
+            flash_interpret=self.flash_interpret,
+            num_experts=self.num_experts,
+            moe_top_k=self.moe_top_k,
+            moe_capacity_factor=self.moe_capacity_factor,
+            expert_axis=self.expert_axis,
+            expert_axis_size=self.expert_axis_size,
+            max_decode_len=self.max_seq_len,
+            rope=self.use_rope,
+            rope_base=self.rope_base,
+            num_kv_heads=self.num_kv_heads,
+            dropout_rate=self.dropout_rate,
+            quant_dense=self.quant_dense,
+            quant_modules=self.quant_modules,
+            quant_kv_cache=self.quant_kv_cache,
+            norm=self.norm,
+            mlp=self.mlp,
+            norm_eps=self.norm_eps,
+            attn_bias=self.attn_bias,
+        )
+        if self.scan_layers:
+            if self.num_experts > 0:
+                raise ValueError(
+                    "scan_layers does not compose with MoE "
+                    f"(num_experts={self.num_experts}): stacking would "
+                    "change the sown aux-loss reduction (each layer's "
+                    "term must be summed, not stacked); run routed "
+                    "blocks unrolled or in the pipeline engine"
+                )
+
+            # One block, scanned over a leading [num_layers] axis: the
+            # carry is the residual stream, params/cache stack per layer
+            # (variable_axes=0), and each layer draws its own init and
+            # dropout rngs (split_rngs). mode/decode_pos/deterministic
+            # ride the closure — they are schedule, not data.
+            def body(block, carry):
+                if mode == "train":
+                    return block(carry, deterministic), None
+                return (
+                    block(carry, deterministic, mode=mode,
+                          decode_pos=decode_pos),
+                    None,
+                )
+
+            x, _ = nn.scan(
+                body,
+                # "intermediates" rides along (stacked per layer) so
+                # capture_intermediates debugging works under the scan;
+                # empty unless a capture filter is active.
+                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=self.num_layers,
+            )(block_cls(**block_kw, name="blocks"), x)
+        else:
+            for i in range(self.num_layers):
+                block = block_cls(**block_kw, name=f"block_{i}")
+                # remat (train-only) rejects non-array kwargs; the
+                # defaults ARE train mode, so pass the decode kwargs only
+                # off of it. ``deterministic`` rides positionally so the
+                # remat static_argnums above keeps it a Python bool.
+                if mode == "train":
+                    x = block(x, deterministic)
+                else:
+                    # Forward ``deterministic`` here too so the unrolled
+                    # and scanned paths agree in every mode (layout
+                    # parity is the scan_layers contract).
+                    x = block(
+                        x, deterministic, mode=mode, decode_pos=decode_pos
+                    )
         x = _norm_cls(self.norm, self.norm_eps)(dtype=self.dtype, name="ln_f")(x)
         if self.tie_embeddings:
             # The attend path reuses the (unquantized) embedding table —
@@ -747,6 +802,44 @@ def transformer_lm(**kw: Any) -> TransformerLM:
     return TransformerLM(**kw)
 
 
+def stack_block_params(params, num_layers: int | None = None):
+    """Unrolled param layout (``block_0`` .. ``block_{L-1}``) -> the
+    ``scan_layers=True`` layout (one ``blocks`` subtree whose leaves
+    carry a leading ``[L]`` layer axis). The non-block leaves (embeddings,
+    ``ln_f``, ``lm_head``) pass through untouched. Inverse of
+    ``unstack_block_params``; parity of the two layouts is pinned in
+    tests/test_scan_layers.py. ``num_layers`` defaults to the count in
+    the tree; an explicit mismatch raises rather than silently dropping
+    layers."""
+    present = sorted(
+        int(k[len("block_"):]) for k in params if k.startswith("block_")
+    )
+    if present != list(range(len(present))):
+        raise ValueError(f"non-contiguous block indices in params: {present}")
+    if num_layers is None:
+        num_layers = len(present)
+    elif num_layers != len(present):
+        raise ValueError(
+            f"num_layers={num_layers} but params carry {len(present)} "
+            "block_* subtrees — stacking would silently drop layers"
+        )
+    blocks = [params[f"block_{i}"] for i in range(num_layers)]
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    rest["blocks"] = jax.tree.map(lambda *ls: jnp.stack(ls), *blocks)
+    return rest
+
+
+def unstack_block_params(params):
+    """``scan_layers`` param layout -> the unrolled ``block_i`` layout
+    (e.g. for HF/torch export, or decoding with an unrolled clone)."""
+    rest = {k: v for k, v in params.items() if k != "blocks"}
+    stacked = params["blocks"]
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    for i in range(n):
+        rest[f"block_{i}"] = jax.tree.map(lambda leaf: leaf[i], stacked)
+    return rest
+
+
 def lm_param_specs(params, tensor_axis: str | None, expert_axis: str | None = None):
     """PartitionSpec tree for a ``TransformerLM`` param tree.
 
@@ -759,6 +852,12 @@ def lm_param_specs(params, tensor_axis: str | None, expert_axis: str | None = No
     replicated); embeddings, layernorms, ``lm_head`` and the post-psum
     ``mlp_out_bias`` stay replicated. With both axes ``None`` everything
     is replicated.
+
+    The ``scan_layers`` layout (one ``blocks`` subtree, leaves with a
+    leading ``[L]`` layer axis) gets the same per-module specs shifted
+    one dim right — the layer axis itself stays unsharded (it is the
+    scan/carry dimension; FSDP-style layer sharding is ``parallel/zero.py``'s
+    job, not the tensor axis's).
     """
     from jax.sharding import PartitionSpec as P
 
@@ -767,17 +866,23 @@ def lm_param_specs(params, tensor_axis: str | None, expert_axis: str | None = No
     def spec(path, leaf):
         names = [getattr(k, "key", str(k)) for k in path]
         module = names[-2] if len(names) >= 2 else ""
+        scanned = bool(names) and names[0] == "blocks"
+
+        def shift(p):
+            # Prepend the unsharded layer dim for stacked leaves.
+            return P(None, *p) if scanned and tuple(p) else p
+
         if module == "moe" and expert_axis is not None:
-            return P(expert_axis)
+            return shift(P(expert_axis))
         if t is None:
             return P()
         leaf_name = names[-1]
         if module in ("q", "k", "v", "mlp_gate"):
-            return P(None, t)
+            return shift(P(None, t))
         if module in ("attn_out", "mlp_out"):
-            return P(t, None)
+            return shift(P(t, None))
         if module == "mlp_in":
-            return P(None, t) if leaf_name == "kernel" else P(t)
+            return shift(P(None, t) if leaf_name == "kernel" else P(t))
         return P()
 
     return jax.tree_util.tree_map_with_path(spec, params)

@@ -310,7 +310,15 @@ def quantize_lm_params(params, modules=QUANT_MODULES) -> Any:
                 and isinstance(sub, Mapping)
                 and "kernel" in sub
             ):
-                qkernel, scale = quantize_int8(jnp.asarray(sub["kernel"]))
+                kernel = jnp.asarray(sub["kernel"])
+                if kernel.ndim == 3:
+                    # scan_layers layout: a stacked [L, K, N] kernel
+                    # quantizes per layer — nn.scan slices it back to
+                    # ([K, N] int8, [N] scale) per step, exactly what
+                    # QuantDense expects.
+                    qkernel, scale = jax.vmap(quantize_int8)(kernel)
+                else:
+                    qkernel, scale = quantize_int8(kernel)
                 new = {"qkernel": qkernel, "scale": scale}
                 for extra, leaf in sub.items():
                     if extra != "kernel":

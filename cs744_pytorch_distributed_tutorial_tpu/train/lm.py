@@ -142,6 +142,13 @@ class LMConfig:
     remat: bool = False
     remat_policy: str = "none"
 
+    # Layer stacking (models/transformer.py::TransformerLM.scan_layers):
+    # run the homogeneous blocks as one nn.scan body instead of L
+    # unrolled copies — identical numerics, O(L) smaller traced program.
+    # The compile-wall lever for deep / big-batch configs; params carry
+    # a leading [L] axis (convert with stack/unstack_block_params).
+    scan_layers: bool = False
+
     # Weight tying: logits = x @ tok_embed^T instead of a separate
     # lm_head (halves the vocab parameters).
     tie_embeddings: bool = False
@@ -318,6 +325,7 @@ class LMTrainer:
             dropout_rate=cfg.dropout_rate,
             norm=cfg.norm,
             mlp=cfg.mlp,
+            scan_layers=cfg.scan_layers,
         )
         if cfg.grad_clip_norm is not None and (
             self.tensor_size > 1 or self.expert_parallel
