@@ -32,12 +32,31 @@ remat, and turning it off buys the dots-policy recompute back:
 The headline when memory allows is remat=off; remat remains the
 long-context/major-batch memory lever it was built as.
 
-Batch scaling (measured, negative): flash at batch 16 is 94.5k tok/s
-(MFU 0.41 — no better than batch 8; the d768 matmuls are already
-MXU-shaped), and batch 32 fails to compile through the tunnel's remote
-compile helper (HTTP 500, both with and without fused_xent — the
-regime fused_xent's memory saving targets is unreachable on this
-single tunneled chip). The batch-8 headline stands.
+Batch scaling, round-4 re-measurement (the round-3 "b16 no better"
+was a dots-only artifact):
+  flash + remat=OFF + b16  147.7 ms/step  110.9k tok/s  MFU 0.481  <- headline
+  flash + remat=off + b24  239.7 ms/step  102.5k tok/s  MFU 0.445
+  flash + remat=dots + b16  (round 3)      94.5k tok/s  MFU 0.41
+Batch 32 fails the tunnel's remote compile helper (HTTP 500) in EVERY
+variant tried round 4 — unrolled/scan_layers x dots/off x fused_xent
+on/off. scan_layers shrinks the traced program by 12x and fused_xent
+removes the 6.6 GB f32 logit buffer, so the wall is the remote compile
+helper itself, not program size or planned memory: a measured
+environment ceiling, not a framework one.
+
+scan_layers on the chip (measured, negative for THIS regime): at b8
+remat-off the scanned stack is 81.7k tok/s (MFU 0.354) vs 104.6k
+unrolled — the layer loop costs ~22% (lost cross-layer fusion +
+while-loop overhead at d768); at b16 remat=dots it is 90.3k vs 94.5k
+unrolled. scan_layers' value is COMPILE scalability (24L+ configs,
+probe_gpt2_medium.py) and O(L)-smaller programs, not single-chip
+throughput at 12L; the bench keeps the unrolled path.
+
+Scoped-vmem compiler option (measured, negative for the LM):
+xla_tpu_scoped_vmem_limit_kib=65536 — the CIFAR bench's +7% lever —
+gives 107.1k on the b16 remat-off config vs 110.9k default-compiled.
+The LM step's Pallas flash kernels manage their own VMEM; the larger
+scoped budget only perturbs XLA's fusion choices here.
 """
 
 from __future__ import annotations
@@ -81,7 +100,8 @@ def gpt2ish_train_flops_per_token() -> float:
     return 3.0 * fwd
 
 
-def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH, remat: bool = True) -> dict:
+def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH,
+                 remat: bool = True, scan_layers: bool = False) -> dict:
     cfg = LMConfig(
         vocab_size=VOCAB,
         num_layers=LAYERS,
@@ -95,6 +115,7 @@ def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH, rema
         compute_dtype="bfloat16",
         remat=remat,
         remat_policy="dots" if remat else "none",
+        scan_layers=scan_layers,
         use_rope=True,
         fused_xent=fused_xent,
     )
@@ -129,7 +150,8 @@ def bench_config(attention_impl: str, fused_xent: bool, batch: int = BATCH, rema
             else None
         ),
         "config": f"{LAYERS}L/{D_MODEL}d/{HEADS}h/T{SEQ}/V{VOCAB}"
-                  f"/b{batch}/bf16/remat={'dots' if remat else 'off'}/rope",
+                  f"/b{batch}/bf16/remat={'dots' if remat else 'off'}/rope"
+                  + ("/scan" if scan_layers else ""),
     }
 
 
@@ -151,9 +173,14 @@ def main() -> None:
     # turning it off.
     print(json.dumps(bench_config("flash", False, BATCH, remat=False)),
           flush=True)
-    for batch, fused in ((16, False), (32, False), (32, True)):
+    # Round-4 headline: batch 16 with remat OFF (round 3 only measured
+    # b16 under remat=dots and concluded "no better" — wrongly).
+    for batch, fused, remat in (
+        (16, False, False), (32, False, True), (32, True, True),
+    ):
         try:
-            print(json.dumps(bench_config("flash", fused, batch)), flush=True)
+            print(json.dumps(bench_config("flash", fused, batch, remat=remat)),
+                  flush=True)
         except Exception as e:
             print(json.dumps({
                 "attention_impl": "flash", "fused_xent": fused,

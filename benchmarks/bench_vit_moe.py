@@ -1,0 +1,237 @@
+"""On-chip numbers for the two families that had none (VERDICT r3 #6).
+
+ViT: train ViT-Ti/4 and ViT-S/4 on CIFAR shapes under the same
+data-parallel Trainer as VGG/ResNet (bf16, flash attention) — ms/step,
+samples/sec, analytic MFU, plus a short loss-descent window on a
+learnable synthetic set so the number is a TRAINING number, not a
+forward benchmark.
+
+MoE: LMTrainer step with a routed Switch FFN (E=8, top-2, d_ff=F)
+against the FLOPs-MATCHED dense model (d_ff=2F — top-2 routing
+computes two F-wide expert FFNs per token, so per-token matmul FLOPs
+are equal up to the router). Reports tokens/sec for both, the MoE
+utilization tax (dispatch/combine einsums + router), and the measured
+drop rate / aux loss from the new fit-history metrics.
+
+MFU accounting: FLOPs = 2*MACs, train = 3x forward, remat off; ViT
+attention FLOPs counted at full (non-causal) N^2.
+
+Measured 2026-07-31, one TPU v5e chip:
+  vit_tiny  b1024: 57.6 ms/step  17.8k samples/sec  MFU 0.099
+  vit_small b512:  77.8 ms/step   6.6k samples/sec  MFU 0.190
+  vit_tiny descent (3 epochs, learnable synthetic): loss 2.52 -> 0.60,
+  test accuracy 80.7% — a training capability, not a forward demo.
+  (Low MFU is the small-model regime: d192/d384 matmuls over 65 tokens
+  underfill the 128-lane MXU; the table exists to make that measured.)
+
+  moe e8/top2 G=1:   230.1 ms  71.2k tok/s   drop 0.1%  (the negative
+                     that motivated grouping: 4.2x slower than dense)
+  moe e8/top2 G=16:   77.8 ms  210.5k tok/s  drop 12.7% at init
+  dense d_ff 2048:    55.2 ms  297.1k tok/s  (FLOPs-matched oracle)
+  GShard grouping cuts the O(N*E*C*D) dispatch by G: 2.96x step
+  speedup, leaving a 1.41x routed-vs-dense tax (router + dispatch/
+  combine einsums + the all-to-all-free single-chip layout). Init-time
+  drop rises at per-group capacity (random router, cf 1.25); training
+  balances it: the 60-step fit trajectory measured drop 8.7% -> 0.7%
+  (G=1) with aux 4.62 -> 4.09.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+V5E_PEAK_FLOPS = 197e12
+STEPS, WARMUP = 12, 8
+
+
+def vit_flops_per_sample(d, layers, d_ff, n_tokens) -> float:
+    """Per-sample forward MACs*2*3: qkv/o projections + MLP + full
+    (non-causal) attention contractions, patch embed + head ignored
+    (<2%)."""
+    per_layer = n_tokens * (4 * d * d + 2 * d * d_ff) + 2 * n_tokens**2 * d
+    return 3.0 * 2.0 * layers * per_layer
+
+
+def bench_vit(model: str, batch: int) -> dict:
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    cfg = TrainConfig(
+        model=model,
+        # ring (explicit collectives): flash can't trace under the
+        # 'auto' strategy's check_vma (see engine guard).
+        sync="ring",
+        num_devices=1,
+        global_batch_size=batch,
+        compute_dtype="bfloat16",
+        synthetic_data=True,
+        vit_attention="flash",
+    )
+    mesh = make_mesh({"data": 1})
+    tr = Trainer(cfg, mesh=mesh)
+    state = tr.init()
+    ds = synthetic_cifar10(batch, 16, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    key = jax.random.key(0)
+    state, m = tr.train_step(state, x, y, key)
+    float(m["loss"])
+    for _ in range(WARMUP):
+        state, m = tr.train_step(state, x, y, key)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, m = tr.train_step(state, x, y, key)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / STEPS
+    dims = {"vit_tiny": (192, 6, 768), "vit_small": (384, 8, 1536)}[model]
+    n_tokens = (32 // 4) ** 2 + 1
+    flops = vit_flops_per_sample(dims[0], dims[1], dims[2], n_tokens)
+    sps = batch / dt
+    return {
+        "metric": f"cifar10_{model}_train_samples_per_sec_per_chip",
+        "ms_per_step": round(dt * 1e3, 2),
+        "samples_per_sec": round(sps),
+        "mfu": (
+            round(sps * flops / V5E_PEAK_FLOPS, 4)
+            if jax.default_backend() != "cpu" else None
+        ),
+        "config": f"{model}/32px/b{batch}/bf16/flash",
+    }
+
+
+def vit_descends() -> dict:
+    """Short training window on the learnable synthetic set: the ViT
+    number is a training capability, not a kernel demo."""
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    cfg = TrainConfig(
+        model="vit_tiny",
+        sync="ring",
+        num_devices=1,
+        global_batch_size=512,
+        compute_dtype="bfloat16",
+        synthetic_data=True,
+        synthetic_train_size=4096,
+        synthetic_test_size=1024,
+        epochs=3,
+        learning_rate=1e-3,
+        optimizer="adamw",
+        vit_attention="flash",
+    )
+    tr = Trainer(cfg)
+    state, history = tr.fit()
+    return {
+        "metric": "vit_tiny_synthetic_descent",
+        "first_loss": round(history["train_loss"][0][2], 4),
+        "final_loss": round(history["train_loss"][-1][2], 4),
+        "final_eval": history["eval"][-1],
+    }
+
+
+def bench_moe(batch: int = 32, seq: int = 512) -> list[dict]:
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    base = dict(
+        vocab_size=50304, num_layers=6, num_heads=8, d_model=512,
+        max_seq_len=seq, seq_len=seq, global_batch_size=batch,
+        attention_impl="flash", compute_dtype="bfloat16", use_rope=True,
+    )
+    rows = []
+    for name, kw in (
+        # top-2 of E=8 F-wide experts vs the FLOPs-matched 2F dense MLP.
+        # Ungrouped (G=1) measured 4.8x slower than dense — the
+        # O(N*E*C*D) dispatch at N=16k tokens; GShard grouping (G=16,
+        # 1024 tokens/group) divides that cost by G.
+        ("moe_e8_top2_g1", dict(d_ff=1024, moe_experts=8, moe_top_k=2)),
+        ("moe_e8_top2_g16", dict(d_ff=1024, moe_experts=8, moe_top_k=2,
+                                 moe_groups=16)),
+        ("dense_matched", dict(d_ff=2048)),
+    ):
+        cfg = LMConfig(**base, **kw)
+        tr = LMTrainer(cfg, mesh=make_mesh({"data": 1, "seq": 1}))
+        params, opt = tr.init()
+        x, y = tr.shard_batch(synthetic_tokens(batch, seq, 50304, seed=0))
+        params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])
+        for _ in range(WARMUP):
+            params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / STEPS
+        row = {
+            "metric": f"moe_vs_dense_{name}",
+            "ms_per_step": round(dt * 1e3, 2),
+            "tokens_per_sec": round(batch * seq / dt),
+            "config": f"6L/512d/{kw.get('d_ff')}ff/b{batch}/T{seq}",
+        }
+        if "moe_experts" in kw:
+            row["moe_drop"] = round(float(m["moe_drop"]), 4)
+            row["moe_aux"] = round(float(m["moe_aux"]), 4)
+        rows.append(row)
+    return rows
+
+
+def moe_training_trajectory() -> dict:
+    """A short real fit() so drop-rate/aux-loss are shown as measured
+    TRAJECTORIES (the test pins the plumbing; this pins the numbers)."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    cfg = LMConfig(
+        vocab_size=512, num_layers=4, num_heads=8, d_model=256, d_ff=512,
+        max_seq_len=256, seq_len=256, global_batch_size=32,
+        attention_impl="flash", compute_dtype="bfloat16", use_rope=True,
+        moe_experts=8, moe_top_k=2, learning_rate=3e-4,
+    )
+    tr = LMTrainer(cfg, mesh=make_mesh({"data": 1, "seq": 1}))
+    tokens = synthetic_tokens(256, 256, 512, seed=0)
+    tr.fit(tokens, steps=60)
+    h = tr.history
+    return {
+        "metric": "moe_fit_trajectory",
+        "loss_first_last": [round(h["loss"][0], 3), round(h["loss"][-1], 3)],
+        "drop_first_last": [
+            round(h["moe_drop"][0], 4), round(h["moe_drop"][-1], 4),
+        ],
+        "aux_first_last": [
+            round(h["moe_aux"][0], 4), round(h["moe_aux"][-1], 4),
+        ],
+    }
+
+
+def main() -> None:
+    which = set(sys.argv[1:]) or {"vit", "vit_descent", "moe", "moe_fit"}
+    if "vit" in which:
+        for model, batch in (("vit_tiny", 1024), ("vit_small", 512)):
+            print(json.dumps(bench_vit(model, batch)), flush=True)
+    if "vit_descent" in which:
+        print(json.dumps(vit_descends()), flush=True)
+    if "moe" in which:
+        for row in bench_moe():
+            print(json.dumps(row), flush=True)
+    if "moe_fit" in which:
+        print(json.dumps(moe_training_trajectory()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -110,6 +110,11 @@ class TrainConfig:
     # exists for shapes/layouts where the kernel wins and for tests.
     fast_conv: bool = False
 
+    # Attention implementation for the ViT family ("dense" model
+    # default, or "flash" for the Pallas kernel); rejected for the conv
+    # families, which have no attention.
+    vit_attention: str | None = None
+
     # Input-pipeline prefetch depth: batches staged ahead by a background
     # thread (the DataLoader num_workers/pin_memory analog,
     # master/part1/part1.py:80-93). 0 disables.

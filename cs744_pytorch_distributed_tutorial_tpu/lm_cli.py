@@ -77,6 +77,9 @@ def build_parser() -> argparse.ArgumentParser:
     # MoE
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--moe-top-k", type=int, default=2)
+    p.add_argument("--moe-groups", type=int, default=1,
+                   help="token groups for MoE routing/capacity (GShard "
+                        "dispatch-cost lever; 0 = auto ~1024 tokens/group)")
     p.add_argument("--moe-expert-parallel", action="store_true")
     # mesh
     p.add_argument("--data-parallel", type=int, default=1)
@@ -221,8 +224,6 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
     # Flags the pipeline engine cannot express are rejected — a silently
     # dropped option would train a different configuration than asked.
     for flag, val, default, why in (
-        ("--seq-parallel", args.seq_parallel, 1,
-         "each pipeline stage holds the full sequence"),
         ("--generate", args.generate, 0,
          "decode runs on the shard_map engine (export params instead)"),
         ("--beam", args.beam, 0,
@@ -255,15 +256,28 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
             f"interleaved (got schedule={args.pipeline_schedule!r})"
         )
     num_virtual = 2 if args.num_virtual_stages is None else args.num_virtual_stages
-    # "ring" is the parser's LM-engine default, meaningless on one
-    # sequence shard — map it to the pipeline engine's dense path;
-    # everything else must be chosen deliberately.
-    attn = "dense" if args.attention_impl == "ring" else args.attention_impl
-    if attn not in ("dense", "flash"):
-        raise SystemExit(
-            f"--attention-impl {args.attention_impl} does not compose with "
-            "--pipeline-parallel (the pipeline engine supports dense|flash)"
-        )
+    if args.seq_parallel > 1:
+        # Sequence parallelism inside the stages (round 4): ring/Ulysses
+        # attention over a "seq" mesh axis; the impl must be one of the
+        # sequence-parallel variants (PipelineLMTrainer validates too).
+        attn = args.attention_impl
+        if attn not in ("ring", "ring_flash", "ulysses", "ulysses_flash"):
+            raise SystemExit(
+                f"--attention-impl {attn} does not compose with "
+                "--seq-parallel (use ring|ring_flash|ulysses|ulysses_flash)"
+            )
+    else:
+        # "ring" is the parser's LM-engine default, meaningless on one
+        # sequence shard — map it to the pipeline engine's dense path;
+        # everything else must be chosen deliberately.
+        attn = "dense" if args.attention_impl == "ring" else args.attention_impl
+        if attn not in ("dense", "flash"):
+            raise SystemExit(
+                f"--attention-impl {args.attention_impl} does not compose "
+                "with --pipeline-parallel without --seq-parallel (the "
+                "pipeline engine supports dense|flash per full-sequence "
+                "stage)"
+            )
     from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
         PipelineLMConfig,
         PipelineLMTrainer,
@@ -283,10 +297,12 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         num_kv_heads=args.num_kv_heads,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
+        moe_groups=args.moe_groups,
         moe_expert_parallel=args.moe_expert_parallel,
         data_parallel=args.data_parallel,
         pipeline_parallel=args.pipeline_parallel,
         tensor_parallel=args.tensor_parallel,
+        seq_parallel=args.seq_parallel,
         num_microbatches=args.num_microbatches,
         schedule=args.pipeline_schedule,
         num_virtual_stages=num_virtual,
@@ -325,6 +341,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
                     "pipeline_parallel": cfg.pipeline_parallel,
                     "data_parallel": cfg.data_parallel,
                     "tensor_parallel": cfg.tensor_parallel,
+                    "seq_parallel": cfg.seq_parallel,
                     "num_microbatches": cfg.num_microbatches,
                     "final_loss": _json_loss(losses[-1]) if losses else None,
                     # null when the run executed zero steps (checkpoint
@@ -344,10 +361,17 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
 
-    if args.int8_decode == "head" and args.tie_embeddings:
+    if (
+        args.int8_decode == "head"
+        and args.tie_embeddings
+        and not args.int8_kv_cache
+    ):
         # Fail BEFORE training: tied embeddings have no lm_head, so the
         # default weight scope would silently quantize nothing
-        # (LMTrainer.quantized_decode_model raises the same way).
+        # (LMTrainer.quantized_decode_model raises the same way). With
+        # --int8-kv-cache the request is NOT a no-op — the cache is the
+        # quantization lever and the weight scope degrades to a no-op
+        # pass-through.
         raise SystemExit(
             "--int8-decode head is a no-op with --tie-embeddings (no "
             "lm_head exists; the attend path stays float) — use "
@@ -416,6 +440,7 @@ def main(argv: list[str] | None = None) -> int:
         fused_xent=args.fused_xent,
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
+        moe_groups=args.moe_groups,
         moe_expert_parallel=args.moe_expert_parallel,
         data_parallel=args.data_parallel,
         seq_parallel=args.seq_parallel,
@@ -486,12 +511,20 @@ def main(argv: list[str] | None = None) -> int:
         else:
             decode_model = trainer.decode_model()
         if args.speculative_k > 0:
-            # Greedy-only, incompatible with beam/sampling/int8 (the
-            # draft shares the float decode path).
-            if args.beam > 0 or args.temperature != 0.0:
+            # temperature 0 = greedy verify; temperature > 0 =
+            # rejection-sampling mode (distribution-exact). top-k/top-p
+            # truncation would break the exactness identity; beam is a
+            # different decoder entirely.
+            if args.beam > 0:
                 raise SystemExit(
-                    "--speculative-k is greedy decoding: needs "
-                    "--temperature 0 and no --beam"
+                    "--speculative-k does not combine with --beam"
+                )
+            if args.top_k is not None or args.top_p is not None:
+                raise SystemExit(
+                    "--speculative-k supports temperature-only sampling "
+                    "(top-k/top-p truncation re-normalizes the target "
+                    "distribution, breaking the rejection-sampling "
+                    "exactness identity)"
                 )
             if args.int8_decode is not None or args.int8_kv_cache:
                 raise SystemExit(
@@ -516,10 +549,17 @@ def main(argv: list[str] | None = None) -> int:
                 draft_tr.decode_model(),
                 max_new_tokens=args.generate,
                 k=args.speculative_k,
+                temperature=args.temperature,
             )
-            out = spec(
+            spec_args = (
                 host_params, jax.device_get(draft_params), prompt_arr[:1]
             )
+            if args.temperature > 0.0:
+                # Rejection-sampling mode draws from the target
+                # distribution — it needs the run's rng key.
+                out = spec(*spec_args, jax.random.key(args.seed))
+            else:
+                out = spec(*spec_args)
         elif args.beam > 0:
             from cs744_pytorch_distributed_tutorial_tpu.infer import (
                 make_beam_searcher,

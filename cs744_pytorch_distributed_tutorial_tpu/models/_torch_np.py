@@ -1,0 +1,20 @@
+"""Shared torch-tensor -> numpy coercion for the interop modules."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def torch_to_np(t: Any) -> np.ndarray:
+    """Anything ``np.asarray`` understands; torch tensors (duck-typed on
+    ``.detach``, so no torch import) get ``.detach().cpu()`` first, with
+    bfloat16/half widened to float32 — those dtypes have no numpy
+    equivalent and ``.numpy()`` raises on them."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu()
+        if t.is_floating_point():
+            t = t.float()
+        t = t.numpy()
+    return np.asarray(t)

@@ -40,14 +40,9 @@ from typing import Any, Mapping
 import numpy as np
 
 
-def _np(t: Any) -> np.ndarray:
-    if hasattr(t, "detach"):
-        t = t.detach().cpu()
-        if t.is_floating_point():
-            # bfloat16/half tensors have no numpy dtype — widen first.
-            t = t.float()
-        t = t.numpy()
-    return np.asarray(t)
+from cs744_pytorch_distributed_tutorial_tpu.models._torch_np import (
+    torch_to_np as _np,
+)
 
 
 def _require_layout(state_dict: Mapping[str, Any], sentinel: str, family: str):

@@ -34,10 +34,9 @@ import numpy as np
 from cs744_pytorch_distributed_tutorial_tpu.models.vgg import VGG_CFGS
 
 
-def _np(t: Any) -> np.ndarray:
-    if hasattr(t, "detach"):  # torch tensor, no torch import needed
-        t = t.detach().cpu().numpy()
-    return np.asarray(t)
+from cs744_pytorch_distributed_tutorial_tpu.models._torch_np import (
+    torch_to_np as _np,
+)
 
 
 def _seq_indices(cfg: Sequence[Any]):

@@ -436,6 +436,7 @@ class Block(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_num_groups: int = 1
     expert_axis: str | None = None
     expert_axis_size: int = 1
     max_decode_len: int | None = None
@@ -536,6 +537,7 @@ class Block(nn.Module):
                 d_ff=self.d_ff,
                 top_k=self.moe_top_k,
                 capacity_factor=self.moe_capacity_factor,
+                num_groups=self.moe_num_groups,
                 dtype=self.dtype,
                 expert_axis=self.expert_axis,
                 expert_axis_size=self.expert_axis_size,
@@ -598,6 +600,7 @@ class TransformerLM(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_num_groups: int = 1
     expert_axis: str | None = None
     expert_axis_size: int = 1
     # Rematerialization: recompute each block's activations during the
@@ -720,6 +723,7 @@ class TransformerLM(nn.Module):
             num_experts=self.num_experts,
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,
+            moe_num_groups=self.moe_num_groups,
             expert_axis=self.expert_axis,
             expert_axis_size=self.expert_axis_size,
             max_decode_len=self.max_seq_len,

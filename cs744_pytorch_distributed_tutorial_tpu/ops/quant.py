@@ -176,6 +176,13 @@ class QuantDense(nn.Module):
     from a trained kernel — ``init`` only zero-fills them for shape) and
     ``scale`` (f32); the optional bias stays float. Inference-only by
     design: the matmul is non-differentiable on the int8 side.
+
+    Bandwidth caveat: when the input feature dim K is not a multiple of
+    128 (the TPU lane width), ``int8_matmul`` silently takes the XLA
+    reference path — numerically identical, but XLA hoists the dequant
+    OUT of a decode scan, so the documented HBM-bytes win evaporates for
+    odd-width models. Pad ``d_model``/``d_ff``/``vocab`` to 128-multiples
+    (as every shipped config does) before benchmarking int8 decode.
     """
 
     features: int

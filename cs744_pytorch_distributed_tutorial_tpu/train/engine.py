@@ -162,6 +162,35 @@ class Trainer:
                     "follows the reference (no dropout)"
                 )
             model_kw["dropout_rate"] = cfg.dropout_rate
+        if cfg.vit_attention is not None:
+            if not cfg.model.startswith("vit"):
+                raise ValueError(
+                    f"vit_attention applies to the ViT family; {cfg.model!r} "
+                    "has no attention"
+                )
+            if cfg.vit_attention not in ("dense", "flash"):
+                raise ValueError(
+                    f"vit_attention must be 'dense' or 'flash', got "
+                    f"{cfg.vit_attention!r}"
+                )
+            if cfg.vit_attention == "flash" and cfg.sync not in (
+                UNCHECKED_REPLICATION | {"none"}
+            ):
+                # Pallas outputs carry no vma annotation, so the flash
+                # kernel cannot trace under check_vma=True — which
+                # sync='auto'/'allreduce' need for the AD-inserted psum.
+                raise ValueError(
+                    "vit_attention='flash' requires an explicit-sync "
+                    f"strategy {sorted(UNCHECKED_REPLICATION)} or 'none' "
+                    f"(got sync={cfg.sync!r}: its replication analysis "
+                    "cannot see through the Pallas kernel)"
+                )
+            model_kw["attention_impl"] = cfg.vit_attention
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+                interpret_kernels,
+            )
+
+            model_kw["flash_interpret"] = interpret_kernels(self.mesh)
         self.model = get_model(
             cfg.model,
             num_classes=cfg.num_classes,

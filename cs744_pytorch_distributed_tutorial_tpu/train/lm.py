@@ -115,6 +115,10 @@ class LMConfig:
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Token groups for routing/capacity (models/moe.py::MoEFFN — the
+    # GShard dispatch-cost lever; 0 = auto ~1024 tokens/group, 1 = one
+    # global group). Part of routing semantics: capacity is per group.
+    moe_groups: int = 1
     moe_expert_parallel: bool = False
     moe_aux_coef: float = 0.01
 
@@ -315,6 +319,7 @@ class LMTrainer:
             num_experts=cfg.moe_experts,
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_num_groups=cfg.moe_groups,
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             remat=cfg.remat,
@@ -421,8 +426,14 @@ class LMTrainer:
         """
         if self.cfg.tie_embeddings and modules == "head":
             # Tied embeddings have no lm_head module (logits ride
-            # tok_embed.attend, deliberately float) — the default scope
-            # would silently quantize NOTHING.
+            # tok_embed.attend, deliberately float), so the default
+            # weight scope quantizes NOTHING. With kv_cache=True that is
+            # fine — the KV cache is the requested lever and needs no
+            # weight scope — so return the KV-only model (its own error
+            # message used to recommend exactly this call). Without it
+            # the whole request would be a silent no-op: raise.
+            if kv_cache:
+                return self.decode_model().clone(quant_kv_cache=True)
             raise ValueError(
                 "int8-decode scope 'head' is a no-op with tied embeddings "
                 "(no lm_head exists; the attend path stays float) — use "
@@ -502,6 +513,7 @@ class LMTrainer:
         has_tensor = TENSOR_AXIS in self.mesh.shape
         data_size, seq_size = self.data_size, self.seq_size
         aux_coef = self.cfg.moe_aux_coef
+        moe_on = self.cfg.moe_experts > 0
 
         def mean_over_replicas(x):
             x = lax.pmean(lax.pmean(x, DATA_AXIS), SEQ_AXIS)
@@ -561,14 +573,17 @@ class LMTrainer:
 
             def loss_fn(p, toks, tgts, drop_key):
                 # mutable=["losses"] collects each MoE layer's sown
-                # load-balancing aux term (empty when the FFNs are dense).
+                # load-balancing aux term (empty when the FFNs are
+                # dense); "metrics" its sown drop rate (monitoring only
+                # — kept out of the objective).
                 apply_kw = (
                     dict(rngs={"dropout": drop_key}, deterministic=False)
                     if dropout > 0.0
                     else {}
                 )
                 logits, mut = model.apply(
-                    {"params": p}, toks, mutable=["losses"], **apply_kw
+                    {"params": p}, toks, mutable=["losses", "metrics"],
+                    **apply_kw
                 )
                 if fused_xent:
                     from cs744_pytorch_distributed_tutorial_tpu.ops.fused_xent import (
@@ -591,7 +606,12 @@ class LMTrainer:
                     moe_aux_loss,
                 )
 
-                return ce + aux_coef * moe_aux_loss(mut)
+                aux = moe_aux_loss(mut)
+                drops = jax.tree_util.tree_leaves(mut.get("metrics", {}))
+                drop = (
+                    sum(drops) / len(drops) if drops else jnp.float32(0.0)
+                )
+                return ce + aux_coef * aux, (aux, drop)
 
             # Differentiate the LOCAL loss, then average grads explicitly
             # per mesh axis. Under ``check_vma=False`` (which the
@@ -608,9 +628,9 @@ class LMTrainer:
             # Equal token counts per shard make pmean of local means the
             # exact global mean.
             if accum == 1:
-                local_loss, grads = jax.value_and_grad(loss_fn)(
-                    params, tokens, targets, drop_base
-                )
+                (local_loss, (aux, drop)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, tokens, targets, drop_base)
             else:
                 # Gradient accumulation: scan over microbatches so only
                 # one microbatch's activations are live at a time; the
@@ -620,35 +640,47 @@ class LMTrainer:
                 mb_keys = jax.random.split(drop_base, accum)
 
                 def body(carry, mb):
-                    g_sum, l_sum = carry
-                    l, g = jax.value_and_grad(loss_fn)(
-                        params, mb[0], mb[1], mb[2]
-                    )
+                    g_sum, l_sum, a_sum, d_sum = carry
+                    (l, (a, dr)), g = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params, mb[0], mb[1], mb[2])
                     return (
                         jax.tree.map(jnp.add, g_sum, g),
                         l_sum + l,
+                        a_sum + a,
+                        d_sum + dr,
                     ), None
 
                 zeros = jax.tree.map(jnp.zeros_like, params)
-                (g_sum, l_sum), _ = lax.scan(
-                    body,
-                    (zeros, jnp.zeros((), jnp.float32)),
-                    (mb_tok, mb_tgt, mb_keys),
+                z = jnp.zeros((), jnp.float32)
+                (g_sum, l_sum, a_sum, d_sum), _ = lax.scan(
+                    body, (zeros, z, z, z), (mb_tok, mb_tgt, mb_keys)
                 )
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 local_loss = l_sum / accum
+                aux, drop = a_sum / accum, d_sum / accum
             grads = jax.tree.map(sync_grad, grads, param_specs)
             loss = mean_over_replicas(local_loss)
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return params, opt_state, {"loss": loss}
+            metrics = {"loss": loss}
+            if moe_on:
+                # MoE observability (VERDICT r3 #6): the load-balancing
+                # aux term and the capacity-overflow drop rate, averaged
+                # over replicas like the loss.
+                metrics["moe_aux"] = mean_over_replicas(aux)
+                metrics["moe_drop"] = mean_over_replicas(drop)
+            return params, opt_state, metrics
 
+        metric_specs = {"loss": P()}
+        if moe_on:
+            metric_specs.update({"moe_aux": P(), "moe_drop": P()})
         mapped_step = jax.jit(
             jax.shard_map(
                 local_step,
                 mesh=self.mesh,
                 in_specs=(param_specs, opt_specs, batch_spec, batch_spec, P()),
-                out_specs=(param_specs, opt_specs, {"loss": P()}),
+                out_specs=(param_specs, opt_specs, metric_specs),
                 check_vma=False,
             ),
             donate_argnums=(0, 1),
@@ -663,6 +695,10 @@ class LMTrainer:
             )
 
         self.train_step = train_step
+        # The raw jitted step, for AOT lower/compile with explicit
+        # compiler_options (bench.py's scoped-vmem recipe); call with an
+        # explicit jnp.int32 step argument.
+        self.jitted_train_step = mapped_step
 
         def local_eval(params, tokens, targets):
             logits = model.apply({"params": params}, tokens)
@@ -752,6 +788,9 @@ class LMTrainer:
                 start_step = int(jax.device_get(restored.step))
                 params, opt_state = restored.params, restored.opt_state
         losses: list[float] = []
+        # Per-step metrics beyond the loss (MoE aux/drop when routed
+        # FFNs are active) — inspect after fit() via ``self.history``.
+        self.history: dict[str, list[float]] = {"loss": losses}
         n = len(tokens)
         b = cfg.global_batch_size
         watchdog = None
@@ -823,6 +862,11 @@ class LMTrainer:
                     ckpt.save(pending_ckpt)
                     pending_ckpt = None
                 losses.append(loss)
+                for key in m:
+                    if key != "loss":
+                        self.history.setdefault(key, []).append(
+                            float(m[key])
+                        )
                 if (
                     ckpt
                     and cfg.checkpoint_every

@@ -12,7 +12,15 @@ just the env var.
 import os
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+    # The concurrency-optimized thunk scheduler issues data-independent
+    # collectives in per-device nondeterministic order; the in-process
+    # CPU communicator's rendezvous then deadlocks (observed on
+    # 1F1B x seq-parallel, where a tick's fwd and bwd halves are
+    # independent). TPU hardware is indifferent (channel-keyed DMAs) —
+    # this is a CPU-harness setting, not a model requirement.
+    + " --xla_cpu_enable_concurrency_optimized_scheduler=false"
 )
 
 import jax

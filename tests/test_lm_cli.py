@@ -97,13 +97,26 @@ def test_pipeline_parallel_route(capsys):
     assert summary["engine"] == "pipeline" and summary["finite"]
 
     # --tensor-parallel COMPOSES since the round-3 promotion (covered in
-    # test_pipeline.py); sequence parallelism genuinely cannot (each
-    # stage holds the full sequence) and must still be rejected.
+    # test_pipeline.py); sequence parallelism composes since round 4 —
+    # but only with a sequence-parallel attention impl ("ring" is the
+    # parser default, so the happy path needs no extra flag).
     with pytest.raises(SystemExit, match="does not compose"):
         main([
             "--pipeline-parallel", "2", "--seq-parallel", "2",
-            "--steps", "1",
+            "--attention-impl", "dense", "--steps", "1",
         ])
+    rc = main([
+        "--pipeline-parallel", "2", "--seq-parallel", "2",
+        "--attention-impl", "ring", "--use-rope", "--num-layers", "2",
+        "--num-heads", "2", "--d-model", "32", "--d-ff", "64",
+        "--max-seq-len", "32", "--seq-len", "16",
+        "--global-batch-size", "4", "--num-seqs", "8", "--steps", "1",
+        "--log-every", "1", "--json",
+    ])
+    assert rc == 0
+    summary = json_.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["engine"] == "pipeline" and summary["finite"]
+    assert summary["seq_parallel"] == 2
 
 
 @pytest.mark.parametrize(
@@ -168,9 +181,18 @@ def test_lm_cli_speculative_decode(capsys):
     assert rc == 0
     summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert len(summary["sample"]) == 6
-    # greedy-only guard
-    with pytest.raises(SystemExit):
+    # temperature > 0 routes to the rejection-sampling mode (round 4)
+    rc = main(TINY + [
+        "--vocab-size", "32", "--generate", "4", "--prompt-len", "4",
+        "--speculative-k", "2", "--draft-layers", "1",
+        "--temperature", "0.8", "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(summary["sample"]) == 4
+    # truncation breaks the exactness identity — still rejected
+    with pytest.raises(SystemExit, match="temperature-only"):
         main(TINY + [
             "--vocab-size", "32", "--generate", "4", "--speculative-k", "2",
-            "--temperature", "0.8",
+            "--temperature", "0.8", "--top-k", "4",
         ])

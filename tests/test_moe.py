@@ -160,3 +160,83 @@ def test_moe_param_shapes_global_vs_local():
     assert {s.data.shape for s in router.addressable_shards} == {
         router.shape
     }  # replicated
+
+
+def test_moe_metrics_surfaced_in_fit_history():
+    """VERDICT r3 #6: the router's load-balance aux term AND the
+    capacity-overflow drop rate must be observable — per-step in the
+    train metrics and accumulated in trainer.history."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    cfg = LMConfig(
+        vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=64, seq_len=16, global_batch_size=4,
+        attention_impl="dense", moe_experts=4,
+        # Tight capacity so drops actually happen and the rate is
+        # meaningfully nonzero.
+        moe_capacity_factor=0.5,
+    )
+    tr = LMTrainer(cfg, mesh=make_mesh({"data": 1, "seq": 1},
+                                       devices=jax.devices()[:1]))
+    tokens = synthetic_tokens(8, 16, 64, seed=0)
+    params, opt = tr.init()
+    x, y = tr.shard_batch(tokens[:4])
+    params, opt, m = tr.train_step(params, opt, x, y)
+    assert set(m) == {"loss", "moe_aux", "moe_drop"}
+    aux, drop = float(m["moe_aux"]), float(m["moe_drop"])
+    assert np.isfinite(aux) and aux > 0.0
+    assert 0.0 < drop < 1.0, drop  # capacity 0.5 must drop something
+
+    tr.fit(tokens, steps=3)
+    assert set(tr.history) == {"loss", "moe_aux", "moe_drop"}
+    assert len(tr.history["moe_drop"]) == 3
+    assert all(0.0 <= d <= 1.0 for d in tr.history["moe_drop"])
+
+    # Dense models keep the old metrics shape — no silent key creep.
+    dense = LMTrainer(cfg.replace(moe_experts=0),
+                      mesh=make_mesh({"data": 1, "seq": 1},
+                                     devices=jax.devices()[:1]))
+    p2, o2 = dense.init()
+    _, _, m2 = dense.train_step(p2, o2, x, y)
+    assert set(m2) == {"loss"}
+
+
+def test_moe_token_groups():
+    """Token grouping (GShard dispatch-cost lever): with capacity slack
+    (cf large enough that nothing drops in either layout) grouping is a
+    pure dispatch reorganization — outputs match the G=1 path; with
+    tight capacity the semantics legitimately differ (capacity is per
+    group) but stay finite and within [0,1] drop rate. Auto mode (0)
+    picks ~1024-token groups."""
+    from cs744_pytorch_distributed_tutorial_tpu.models.moe import MoEFFN
+
+    x = jax.random.normal(jax.random.key(0), (4, 64, 32))  # N=256
+    kw = dict(num_experts=4, d_ff=64, top_k=2, capacity_factor=4.0)
+    m1 = MoEFFN(**kw, num_groups=1)
+    params = m1.init(jax.random.key(1), x)["params"]
+    y1 = m1.apply({"params": params}, x)
+    m4 = MoEFFN(**kw, num_groups=4)
+    y4 = m4.apply({"params": params}, x)  # same params: grouping is
+    np.testing.assert_allclose(                  # not a param change
+        np.asarray(y1), np.asarray(y4), rtol=2e-5, atol=2e-5
+    )
+
+    # Auto grouping resolves to a divisor of N.
+    m0 = MoEFFN(**kw, num_groups=0)
+    y0 = m0.apply({"params": params}, x)
+    assert np.isfinite(np.asarray(y0)).all()
+
+    # Non-divisor requests degrade to the largest divisor <= requested
+    # (decode calls N as small as 1 token through train-configured
+    # groups); the output stays finite and the extreme g=N degenerates
+    # to per-token groups without error.
+    m3 = MoEFFN(**kw, num_groups=3)  # 3 -> effective 2 for N=256? no:
+    y3 = m3.apply({"params": params}, x)  # largest divisor of 256 <= 3 = 2
+    assert np.isfinite(np.asarray(y3)).all()
+    single = MoEFFN(**kw, num_groups=1)
+    y_one_tok = single.apply(
+        {"params": params}, x[:1, :1, :]
+    )  # N=1: any group request must degrade to 1
+    assert y_one_tok.shape == (1, 1, 32)

@@ -24,10 +24,10 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
 
 def make_trainer(data=1, pipe=4, layers=4, microbatches=2, batch=8, **kw):
     cfg = PipelineLMConfig(
-        vocab_size=64,
+        vocab_size=kw.pop("vocab_size", 64),
         num_layers=layers,
         num_heads=4,
-        d_model=32,
+        d_model=kw.pop("d_model", 32),
         d_ff=64,
         max_seq_len=64,
         data_parallel=data,
@@ -856,3 +856,178 @@ def test_1f1b_schedule_stats():
     # tick span identical: the lockstep-SPMD 1F1B identity
     assert st["f1b_waves"] == st["gpipe_ticks"] // 2 + (4 - 1)
     assert 0 < st["bubble_fraction"] < 1
+
+
+# --------------------------------------------------------------------------
+# Sequence parallelism inside pipeline stages (round 4, VERDICT r3 #5)
+# --------------------------------------------------------------------------
+def _sp_pp_trainer(sp, pipe=2, data=1, impl="ring", schedule="gpipe", **kw):
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        SEQ_AXIS,
+    )
+
+    cfg = PipelineLMConfig(
+        vocab_size=64,
+        num_layers=4,
+        num_heads=4,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=kw.pop("max_seq_len", 64),
+        data_parallel=data,
+        pipeline_parallel=pipe,
+        seq_parallel=sp,
+        attention_impl=impl,
+        schedule=schedule,
+        num_microbatches=2,
+        global_batch_size=4 * data,
+        seq_len=kw.pop("seq_len", 16),
+        use_rope=kw.pop("use_rope", True),
+        **kw,
+    )
+    axes = {DATA_AXIS: data, PIPE_AXIS: pipe}
+    if sp > 1:
+        axes[SEQ_AXIS] = sp
+    mesh = make_mesh(axes, devices=jax.devices()[: data * pipe * max(sp, 1)])
+    return PipelineLMTrainer(cfg, mesh=mesh)
+
+
+@pytest.mark.parametrize("impl,schedule", [
+    ("ring", "gpipe"),
+    ("ring", "1f1b"),
+    ("ulysses", "gpipe"),
+])
+def test_sp_pp_loss_parity(impl, schedule):
+    """sp=2 inside pp=2 reproduces the sp=1 pipeline's loss trajectory
+    from the same init — the seq sharding (ring/Ulysses attention, seq-
+    sharded batch, seq-axis grad/loss reduction) is exactly a layout
+    change."""
+    base_impl = "dense"
+    tr_ref = _sp_pp_trainer(1, impl=base_impl, schedule=schedule)
+    tr_sp = _sp_pp_trainer(2, impl=impl, schedule=schedule)
+    toks = tokens_for(tr_ref.cfg)
+
+    losses = {}
+    for name, tr in (("ref", tr_ref), ("sp", tr_sp)):
+        params, opt = tr.init(3)
+        x, y = tr.shard_batch(toks)
+        ls = []
+        for step in range(3):
+            params, opt, m = tr.train_step(params, opt, x, y, step)
+            ls.append(float(m["loss"]))
+        # Drain ALL device work before the next trainer launches: the
+        # loss fetch fences only the loss — the param-update collectives
+        # can still be in flight, and the in-process CPU rendezvous
+        # deadlocks if a different-mesh program overlaps them on the
+        # same device threads.
+        jax.block_until_ready((params, opt))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["ref"], losses["sp"], rtol=2e-5)
+
+
+def test_sp_pp_abs_positions():
+    """Non-RoPE path: the absolute position table is sliced at each seq
+    shard's GLOBAL offset — forward logits match the sp=1 pipeline."""
+    tr_ref = _sp_pp_trainer(1, impl="dense", use_rope=False)
+    tr_sp = _sp_pp_trainer(2, impl="ring", use_rope=False)
+    toks = tokens_for(tr_ref.cfg)
+    x = jnp.asarray(toks[:, :-1])
+    p_ref, _ = tr_ref.init(5)
+    p_sp, _ = tr_sp.init(5)
+    want = np.asarray(tr_ref.forward_fn(p_ref, x))
+    got = np.asarray(tr_sp.forward_fn(p_sp, x))
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_sp_pp_tp_composes(mesh8):
+    """dp x sp x tp inside pp on one 4-D mesh: one finite training step
+    (the full composition — ring attention over seq, Megatron sharding
+    over tensor, stages over pipe, batch over data)."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        SEQ_AXIS, TENSOR_AXIS,
+    )
+
+    cfg = PipelineLMConfig(
+        vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=64, data_parallel=1, pipeline_parallel=2,
+        seq_parallel=2, tensor_parallel=2, attention_impl="ring",
+        num_microbatches=2, global_batch_size=4, seq_len=16, use_rope=True,
+    )
+    mesh = make_mesh({DATA_AXIS: 1, PIPE_AXIS: 2, SEQ_AXIS: 2,
+                      TENSOR_AXIS: 2})
+    tr = PipelineLMTrainer(cfg, mesh=mesh)
+    params, opt = tr.init()
+    x, y = tr.shard_batch(tokens_for(cfg))
+    params, opt, m = tr.train_step(params, opt, x, y)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_sp_pp_validation():
+    with pytest.raises(ValueError, match="incompatible with seq_parallel"):
+        _sp_pp_trainer(2, impl="dense")
+    with pytest.raises(ValueError, match="not divisible by seq axis"):
+        _sp_pp_trainer(2, impl="ring", seq_len=15, max_seq_len=30)
+
+
+# --------------------------------------------------------------------------
+# 1F1B distributed tail (round 4, VERDICT r3 #7)
+# --------------------------------------------------------------------------
+def _dot_operand_shapes(jaxpr, out=None):
+    """All dot_general operand shapes, recursing into sub-jaxprs
+    (ClosedJaxpr params like pjit/scan AND raw Jaxpr params like
+    shard_map's)."""
+    out = [] if out is None else out
+
+    def visit(v):
+        if hasattr(v, "jaxpr"):  # ClosedJaxpr
+            _dot_operand_shapes(v.jaxpr, out)
+        elif hasattr(v, "eqns"):  # raw Jaxpr
+            _dot_operand_shapes(v, out)
+        elif isinstance(v, (list, tuple)):
+            for b in v:
+                visit(b)
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            out.append(tuple(tuple(v.aval.shape) for v in eqn.invars))
+        for v in eqn.params.values():
+            visit(v)
+    return out
+
+
+def test_1f1b_distributed_tail_head_width():
+    """tp=1 1F1B shards the per-wave tail over the pipe axis: the jaxpr
+    must contain head matmuls at V/S width and NONE at full V width —
+    total head FLOPs per microbatch = S * V/S = one full head matmul,
+    not one per stage (the round-3 S x dead-compute tax)."""
+    # vocab chosen so the head widths (192 full / 48 per slice) collide
+    # with no block matmul dim (d_model 32, d_ff 64).
+    d_model, vocab, pipe = 32, 192, 4
+    tr = make_trainer(data=1, pipe=pipe, layers=4, microbatches=2,
+                      batch=4, vocab_size=vocab, d_model=d_model)
+    assert tr.cfg.schedule == "gpipe"
+    tr_f = make_trainer(data=1, pipe=pipe, layers=4, microbatches=2,
+                        batch=4, vocab_size=vocab, d_model=d_model,
+                        schedule="1f1b")
+    assert tr_f._dist_tail
+    params, opt = tr_f.init()
+    x, y = tr_f.shard_batch(tokens_for(tr_f.cfg))
+    jaxpr = jax.make_jaxpr(
+        lambda p, o, a, b: tr_f.jitted_train_step(p, o, a, b, jnp.int32(0))
+    )(params, opt, x, y)
+    shapes = _dot_operand_shapes(jaxpr.jaxpr)
+    full = [s for s in shapes if (d_model, vocab) in s or (vocab, d_model) in s]
+    sliced = [s for s in shapes if (d_model, vocab // pipe) in s]
+    assert not full, f"full-vocab head dot survived: {full}"
+    assert sliced, "no V/S-width head dot found — tail not sharded?"
+
+
+def test_1f1b_distributed_tail_fallback_when_indivisible():
+    """vocab % pipe != 0 falls back to the replicated tail (correct,
+    just unsharded) rather than refusing the config."""
+    tr = make_trainer(data=1, pipe=4, layers=4, microbatches=2,
+                      batch=4, vocab_size=66, schedule="1f1b")
+    assert not tr._dist_tail
+    params, opt = tr.init()
+    x, y = tr.shard_batch(tokens_for(tr.cfg))
+    params, opt, m = tr.train_step(params, opt, x, y)
+    assert np.isfinite(float(m["loss"]))

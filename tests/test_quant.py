@@ -300,3 +300,25 @@ def test_quantized_eval_loss_close_after_training():
         quantize_lm_params(host, mods),
     )
     assert abs(q8 - fp) < 0.02 * max(fp, 1.0), (fp, q8)
+
+
+def test_tied_embeddings_kv_only_decode_model():
+    """ADVICE r3: tie_embeddings + modules='head' used to raise even with
+    kv_cache=True — while the error message recommended kv_cache=True.
+    The KV-only request is legitimate (the weight scope degrades to a
+    no-op pass-through): it must return a cache-quantized float-weight
+    model, and still raise without the cache."""
+    import pytest
+
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    cfg = LMConfig(
+        vocab_size=64, num_layers=1, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=64, seq_len=32, global_batch_size=4,
+        attention_impl="dense", tie_embeddings=True,
+    )
+    tr = LMTrainer(cfg)
+    m = tr.quantized_decode_model("head", kv_cache=True)
+    assert m.quant_kv_cache and not m.quant_dense
+    with pytest.raises(ValueError, match="no-op with tied embeddings"):
+        tr.quantized_decode_model("head", kv_cache=False)

@@ -9,6 +9,7 @@ round-trips in both directions.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -132,3 +133,18 @@ def test_unknown_arch_and_wrong_head_rejected(tmodel):
     sd["fc1.weight"] = torch.zeros(10, 2048)
     with pytest.raises(ValueError, match="512-feature head"):
         vgg_variables_from_torch_state_dict(sd)
+
+
+def test_bf16_state_dict_imports(tmodel):
+    """ADVICE r3: _np must widen bf16/half tensors before .numpy()
+    (no numpy dtype exists for them) — same contract as hf_interop."""
+    import torch
+
+    sd = {k: v.to(torch.bfloat16) if v.is_floating_point() else v
+          for k, v in tmodel.state_dict().items()}
+    variables = vgg_variables_from_torch_state_dict(sd)
+    ref = vgg_variables_from_torch_state_dict(tmodel.state_dict())
+    a = jax.tree.leaves(variables)[0]
+    b = jax.tree.leaves(ref)[0]
+    # bf16 rounding, not garbage: close to the fp32 import.
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0.02)
