@@ -31,6 +31,29 @@ attempts measured 0.4-0.9x "slowdowns" that were pure tunnel weather —
 RTT swung 3-500 ms in-session; the trace is ground truth. A random
 (untrained-agreement) draft costs ~3x plain in device time at k=8 —
 speculation must be earned by a draft that actually agrees.
+
+EARNED-ACCEPTANCE regime, round 4 (VERDICT r3 #3a) — undertrained
+drafts picked by a step sweep to land in the 0.5-0.9 agreement band:
+  agreement 0.81 (330-step draft):
+    k=2 1.87x (acc 0.72)   k=4 1.81x (acc 0.63)   k=8 1.40x (acc 0.44)
+  agreement 0.52 (260-step draft):
+    k=2 1.44x (acc 0.43)   k=4 1.05x (acc 0.26)   k=8 0.64x (acc 0.13)
+  agreement 0.24 (120-step draft):
+    k=2 0.99x              k=4 0.68x              k=8 0.41x
+The shape is the textbook speculative curve: real speedup needs
+agreement >~0.5, moderate-acceptance pairs want SMALL k (k=2 dominates
+at 0.5; k=8 only pays at >~0.7), and a weak draft is a net LOSS. Also
+measured: the band only exists on in-distribution prompts — on an
+off-distribution prompt the target's own continuation is chaotic and
+even a near-converged draft scores ~0.2 agreement (agreement-vs-steps:
+150->0.27, 200->0.35, 260->0.52, 330->0.81, 420->0.99).
+
+SAMPLING mode, round 4 (VERDICT r3 #3b) — rejection-sampling
+speculative at temperature 0.8 vs plain sampling (distribution
+exactness pinned separately by the chi-square test):
+  plain sampling   12.9 ms/gen  19.9k tok/s
+  k=4               6.1 ms/gen  41.9k tok/s  (2.11x)  acceptance 1.00
+  k=8               5.6 ms/gen  45.4k tok/s  (2.29x)  acceptance 0.98
 """
 
 from __future__ import annotations
@@ -149,25 +172,28 @@ def main() -> None:
     sweep("speculative", target, draft, tp, dp, base, prompt)
 
     # ---- earned-acceptance regime (VERDICT r3 #3a) ----------------------
-    # An UNDERTRAINED shallow draft against the converged target: the
-    # acceptance a real draft/target pair lives at (0.5-0.9), not the
-    # memorized-corpus ~1.0 above. Two undertraining levels bracket the
-    # band; prompts come from the corpus tail the drafts barely fit.
-    tail_prompt = jnp.asarray(corpus[-1:, :PROMPT], jnp.int32)
-    base_t = min(timed(plain, tp, tail_prompt, key) for _ in range(ROUNDS))
+    # UNDERTRAINED shallow drafts against the converged target, picked
+    # (by a step sweep) to land teacher-forced agreement in the 0.5-0.9
+    # band a real draft/target pair lives at: 260 steps -> ~0.5, 330 ->
+    # ~0.8 on this corpus. A byte-LM transitions through the band
+    # quickly (agreement vs steps: 150->0.27, 200->0.35, 260->0.52,
+    # 330->0.81, 420->0.99), and on OFF-distribution prompts the band
+    # does not exist at all — the target's own continuation is chaotic
+    # there and even a near-converged draft measures ~0.2 agreement
+    # (measured; the tail-prompt rows of an earlier revision).
     for label, steps, dm, dff in (
-        ("draft-500step", 500, 256, 1024),
-        ("draft-300step", 300, 256, 1024),
+        ("draft-330step", 330, 256, 1024),
+        ("draft-260step", 260, 256, 1024),
         ("draft-120step", 120, 256, 1024),
     ):
         u_tr, up, ul = train(1, dm, dff, corpus, steps=steps)
         u_draft = u_tr.decode_model()
-        agree_u = agreement(u_draft, tp, up, plain, tail_prompt)
+        agree_u = agreement(u_draft, tp, up, plain, prompt)
         print(
             f"{label} (1L/{dm}d, loss {ul:.2f}): "
             f"teacher-forced agreement {agree_u:.2f}"
         )
-        sweep(f"  {label}", target, u_draft, tp, up, base_t, tail_prompt)
+        sweep(f"  {label}", target, u_draft, tp, up, base, prompt)
 
     # ---- sampling mode (VERDICT r3 #3b) ---------------------------------
     # Rejection-sampling speculative vs plain sampling at the same

@@ -50,12 +50,44 @@ class PrefetchIterator(Iterator[T]):
 
     def _produce(self, it: Iterator[T]) -> None:
         try:
+            prev = None
             for item in it:
+                # Materialize the PREVIOUS item on the producer thread
+                # before offering the next: the consumer never absorbs
+                # deferred device_put work inside its own dispatch
+                # chain, while THIS item's transfer still overlaps the
+                # next batch's host assembly (blocking on the fresh item
+                # itself would serialize gather with transfer — the
+                # overlap this thread exists for). One-behind is enough:
+                # by the time the consumer dequeues an item, its
+                # successor's production has fenced it. Transfer errors
+                # surface here and relay to the consumer like any other
+                # producer exception.
+                self._block_ready(prev)
+                prev = item
                 if not self._offer(item):
                     return
+            self._block_ready(prev)
             self._offer(_STOP)
         except BaseException as e:  # noqa: BLE001 — relayed to consumer
             self._offer(e)
+            # Terminate the stream for consumers that keep reading after
+            # catching the relayed exception (a further next() would
+            # otherwise block forever on the empty queue).
+            self._offer(_STOP)
+
+    @staticmethod
+    def _block_ready(item) -> None:
+        if item is None:
+            return
+        try:
+            import jax
+        except Exception:  # pragma: no cover — jax-less host tooling
+            return
+        # Non-array leaves pass through untouched (block_until_ready
+        # ignores them); DEVICE errors deliberately propagate — the
+        # producer's relay is exactly where they belong.
+        jax.block_until_ready(item)
 
     def __iter__(self) -> "PrefetchIterator[T]":
         return self
