@@ -126,6 +126,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "'finite': false in --json) instead of raising "
                         "NonFiniteLossError")
     p.add_argument("--accum-steps", type=int, default=1)
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard both AdamW moments over the data "
+                        "axis (optimizer memory / data_parallel); "
+                        "requires adamw, tensor-parallel 1, no expert "
+                        "parallelism, no grad clipping")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--checkpoint-dir", default=None)
@@ -224,6 +229,8 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
     # Flags the pipeline engine cannot express are rejected — a silently
     # dropped option would train a different configuration than asked.
     for flag, val, default, why in (
+        ("--zero1", args.zero1, False,
+         "sharded-moment AdamW lives on the shard_map engine"),
         ("--generate", args.generate, 0,
          "decode runs on the shard_map engine (export params instead)"),
         ("--beam", args.beam, 0,
@@ -458,6 +465,7 @@ def main(argv: list[str] | None = None) -> int:
         label_smoothing=args.label_smoothing,
         dropout_rate=args.dropout_rate,
         accum_steps=args.accum_steps,
+        zero1=args.zero1,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,

@@ -173,3 +173,122 @@ class FsdpSGD(Zero1SGD):
         new_shards = jax.tree.map(lambda _, o: o[0], param_shards, out)
         new_momenta = jax.tree.map(lambda _, o: o[1], param_shards, out)
         return new_shards, new_momenta
+
+
+class Zero1Adam:
+    """ZeRO-1 AdamW for the LM engine: both Adam moments live ONLY as
+    data-axis-sharded ``[axis_size, chunk]`` flat chunks per leaf —
+    optimizer memory drops from 2x params to 2x params / axis_size per
+    device, the lever that matters at transformer parameter counts
+    (GPT-2-medium's f32 moments are ~2.8 GB replicated).
+
+    The update math is optax.adamw's exactly (decoupled weight decay,
+    bias correction, b1/b2/eps conventions), applied chunk-wise —
+    elementwise, so chunking changes nothing but summation layout:
+    the trajectory matches the replicated optimizer to float tolerance
+    (tests/test_zero1_lm.py pins it).
+
+    Communication per step and leaf: one ``psum_scatter`` of the LOCAL
+    (unsynced) gradient — which IS the data-mean reduction, delivered
+    pre-sharded at half an allreduce's bytes — plus one ``all_gather``
+    of the parameter deltas; together the same bytes as the allreduce
+    they replace (the ZeRO-1 identity, as Zero1SGD above). Sequence-
+    axis replicas contribute via a pmean on the CHUNK (cheap: 1/dp of
+    the leaf).
+
+    ``init`` runs on host (global ``[axis_size, chunk]`` zeros; the
+    trainer shards dim 0 over the data axis); ``apply`` runs inside
+    ``shard_map`` where each moment leaf arrives as its ``[1, chunk]``
+    local shard and params arrive replicated.
+    """
+
+    def __init__(
+        self,
+        schedule,
+        b1: float,
+        b2: float,
+        eps: float,
+        weight_decay: float,
+        axis_name: str,
+        axis_size: int,
+        seq_axis: str | None = None,
+        seq_size: int = 1,
+    ):
+        self.schedule = schedule
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self.weight_decay = weight_decay
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+        self.seq_axis = seq_axis
+        self.seq_size = seq_size
+
+    def _chunk(self, size: int) -> int:
+        return -(-size // self.axis_size)  # ceil
+
+    def init(self, params):
+        moment = lambda: jax.tree.map(
+            lambda p: jnp.zeros(
+                (self.axis_size, self._chunk(p.size)), jnp.float32
+            ),
+            params,
+        )
+        return {
+            "mu": moment(),
+            "nu": moment(),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def apply(self, params, state, grads):
+        """One ZeRO-1 AdamW step from LOCAL (pre-sync) grads: returns
+        (replicated new params, new state with local moment shards)."""
+        s = self.axis_size
+        count = state["count"] + 1
+        # optax's scale_by_schedule evaluates the schedule at the count
+        # BEFORE this update (0 on the first step); the bias correction
+        # uses the incremented count — match both conventions exactly.
+        lr = (
+            self.schedule(state["count"])
+            if callable(self.schedule)
+            else self.schedule
+        )
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def leaf(p, mu, nu, g):
+            chunk = self._chunk(p.size)
+            pad = s * chunk - p.size
+            g2d = jnp.pad(
+                g.ravel().astype(jnp.float32), (0, pad)
+            ).reshape(s, chunk)
+            # Reduce-scatter the SUM, divide: this device's chunk of the
+            # data-mean gradient; seq replicas then average on the chunk.
+            g_mine = (
+                lax.psum_scatter(g2d, self.axis_name, scatter_dimension=0)
+                / s
+            )
+            if self.seq_axis is not None and self.seq_size > 1:
+                g_mine = lax.pmean(g_mine, self.seq_axis)
+            p2d = jnp.pad(
+                p.ravel().astype(jnp.float32), (0, pad)
+            ).reshape(s, chunk)
+            p_mine = lax.dynamic_index_in_dim(
+                p2d, lax.axis_index(self.axis_name), 0, keepdims=False
+            )
+            mu_n = b1 * mu.reshape(chunk) + (1.0 - b1) * g_mine
+            nu_n = b2 * nu.reshape(chunk) + (1.0 - b2) * g_mine * g_mine
+            update = mu_n / c1 / (jnp.sqrt(nu_n / c2) + eps) + wd * p_mine
+            delta_mine = -lr * update
+            delta = lax.all_gather(delta_mine, self.axis_name, axis=0)
+            new_p = (p.ravel().astype(jnp.float32) + delta.reshape(-1)[: p.size])
+            return (
+                new_p.reshape(p.shape).astype(p.dtype),
+                mu_n.reshape(1, chunk),
+                nu_n.reshape(1, chunk),
+            )
+
+        out = jax.tree.map(leaf, params, state["mu"], state["nu"], grads)
+        pick = lambda i: jax.tree.map(
+            lambda _, o: o[i], params, out
+        )
+        return pick(0), {"mu": pick(1), "nu": pick(2), "count": count}
