@@ -131,6 +131,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "axis (optimizer memory / data_parallel); "
                         "requires adamw, tensor-parallel 1, no expert "
                         "parallelism, no grad clipping")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO-3/FSDP: params AND AdamW moments persist "
+                        "as data-axis-sharded chunks, gathered "
+                        "just-in-time per step (3x-params state / "
+                        "data_parallel); same restrictions as --zero1")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--checkpoint-dir", default=None)
@@ -231,6 +236,8 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
     for flag, val, default, why in (
         ("--zero1", args.zero1, False,
          "sharded-moment AdamW lives on the shard_map engine"),
+        ("--fsdp", args.fsdp, False,
+         "chunk-sharded params live on the shard_map engine"),
         ("--generate", args.generate, 0,
          "decode runs on the shard_map engine (export params instead)"),
         ("--beam", args.beam, 0,
@@ -466,6 +473,7 @@ def main(argv: list[str] | None = None) -> int:
         dropout_rate=args.dropout_rate,
         accum_steps=args.accum_steps,
         zero1=args.zero1,
+        fsdp=args.fsdp,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
@@ -505,7 +513,13 @@ def main(argv: list[str] | None = None) -> int:
             )
         else:
             prompt_ids = tokens[:1, : args.prompt_len]
-        host_params = jax.device_get(params)
+        # FSDP params persist as [dp, chunk] shards — unshard for the
+        # decode tree; other layouts fetch the global arrays directly.
+        host_params = (
+            trainer.gather_for_decode(params)
+            if args.fsdp
+            else jax.device_get(params)
+        )
         prompt_arr = np.asarray(prompt_ids, dtype=np.int32)
         if args.int8_decode is not None:
             decode_model = trainer.quantized_decode_model(
@@ -552,6 +566,13 @@ def main(argv: list[str] | None = None) -> int:
             )
             draft_tr = LMTrainer(draft_cfg)
             draft_params, _, _ = draft_tr.fit(tokens, args.steps)
+            # The draft inherits fsdp via the cfg replace — its chunked
+            # params unshard the same way the target's did.
+            draft_host = (
+                draft_tr.gather_for_decode(draft_params)
+                if args.fsdp
+                else jax.device_get(draft_params)
+            )
             spec = make_speculative_generator(
                 decode_model,
                 draft_tr.decode_model(),

@@ -32,6 +32,36 @@ import jax.numpy as jnp
 from jax import lax
 
 
+
+def _shard_flat(params, axis_size: int):
+    """GLOBAL param tree -> ``[axis_size, chunk]`` zero-padded flat
+    shards (the shared ZeRO-3 layout; host-side)."""
+
+    def leaf(p):
+        chunk = -(-p.size // axis_size)
+        return jnp.pad(p.ravel(), (0, axis_size * chunk - p.size)).reshape(
+            axis_size, chunk
+        )
+
+    return jax.tree.map(leaf, params)
+
+
+def _gather_flat(shards, shape_tree, axis_name: str):
+    """Inside ``shard_map``: local ``[1, chunk]`` shards -> full params
+    (the FSDP unshard; ``shape_tree`` leaves carry ``.shape``/``.dtype``,
+    e.g. from ``jax.eval_shape`` of host init)."""
+
+    def leaf(sh, sds):
+        full = lax.all_gather(sh.reshape(-1), axis_name, axis=0)
+        return (
+            full.reshape(-1)[: math.prod(sds.shape)]
+            .reshape(sds.shape)
+            .astype(sds.dtype)
+        )
+
+    return jax.tree.map(leaf, shards, shape_tree)
+
+
 class Zero1SGD:
     """SGD(momentum, weight-decay) with data-axis-sharded momentum.
 
@@ -133,26 +163,12 @@ class FsdpSGD(Zero1SGD):
     """
 
     def shard_params(self, params):
-        """Host-side: GLOBAL param tree -> ``[axis_size, chunk]`` flat
-        shards (zero-padded)."""
-        s = self.axis_size
-
-        def leaf(p):
-            chunk = self._chunk(p.size)
-            return jnp.pad(p.ravel(), (0, s * chunk - p.size)).reshape(s, chunk)
-
-        return jax.tree.map(leaf, params)
+        """GLOBAL param tree -> ``[axis_size, chunk]`` flat shards."""
+        return _shard_flat(params, self.axis_size)
 
     def gather_params(self, shards, shape_tree):
-        """Inside ``shard_map``: local ``[1, chunk]`` shards -> full
-        params (the FSDP unshard). ``shape_tree`` leaves carry ``.shape``
-        (e.g. the ``jax.eval_shape`` of host init)."""
-
-        def leaf(sh, sds):
-            full = lax.all_gather(sh.reshape(-1), self.axis_name, axis=0)
-            return full.reshape(-1)[: math.prod(sds.shape)].reshape(sds.shape)
-
-        return jax.tree.map(leaf, shards, shape_tree)
+        """Local ``[1, chunk]`` shards -> full params (``_gather_flat``)."""
+        return _gather_flat(shards, shape_tree, self.axis_name)
 
     def apply(self, param_shards, momenta, grad_chunks):
         """One FSDP step from CHUNKED grad sums (the ``[1, chunk]``
@@ -238,22 +254,38 @@ class Zero1Adam:
             "count": jnp.zeros((), jnp.int32),
         }
 
-    def apply(self, params, state, grads):
-        """One ZeRO-1 AdamW step from LOCAL (pre-sync) grads: returns
-        (replicated new params, new state with local moment shards)."""
-        s = self.axis_size
+    def _step_scalars(self, state):
+        """(incremented count, lr, bias corrections) for one update.
+        optax's scale_by_schedule evaluates the schedule at the count
+        BEFORE this update (0 on the first step); the bias correction
+        uses the incremented count — match both conventions exactly."""
         count = state["count"] + 1
-        # optax's scale_by_schedule evaluates the schedule at the count
-        # BEFORE this update (0 on the first step); the bias correction
-        # uses the incremented count — match both conventions exactly.
         lr = (
             self.schedule(state["count"])
             if callable(self.schedule)
             else self.schedule
         )
-        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
-        c1 = 1.0 - b1 ** count.astype(jnp.float32)
-        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        c1 = 1.0 - self.b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - self.b2 ** count.astype(jnp.float32)
+        return count, lr, c1, c2
+
+    def _adamw_chunk_update(self, p_mine, mu, nu, g_mine, c1, c2):
+        """The optax.adamw rule on one f32 chunk: returns
+        (new_mu, new_nu, update) with the decoupled-decay term folded in
+        (the caller scales by -lr)."""
+        mu_n = self.b1 * mu + (1.0 - self.b1) * g_mine
+        nu_n = self.b2 * nu + (1.0 - self.b2) * g_mine * g_mine
+        update = (
+            mu_n / c1 / (jnp.sqrt(nu_n / c2) + self.eps)
+            + self.weight_decay * p_mine
+        )
+        return mu_n, nu_n, update
+
+    def apply(self, params, state, grads):
+        """One ZeRO-1 AdamW step from LOCAL (pre-sync) grads: returns
+        (replicated new params, new state with local moment shards)."""
+        s = self.axis_size
+        count, lr, c1, c2 = self._step_scalars(state)
 
         def leaf(p, mu, nu, g):
             chunk = self._chunk(p.size)
@@ -275,9 +307,9 @@ class Zero1Adam:
             p_mine = lax.dynamic_index_in_dim(
                 p2d, lax.axis_index(self.axis_name), 0, keepdims=False
             )
-            mu_n = b1 * mu.reshape(chunk) + (1.0 - b1) * g_mine
-            nu_n = b2 * nu.reshape(chunk) + (1.0 - b2) * g_mine * g_mine
-            update = mu_n / c1 / (jnp.sqrt(nu_n / c2) + eps) + wd * p_mine
+            mu_n, nu_n, update = self._adamw_chunk_update(
+                p_mine, mu.reshape(chunk), nu.reshape(chunk), g_mine, c1, c2
+            )
             delta_mine = -lr * update
             delta = lax.all_gather(delta_mine, self.axis_name, axis=0)
             new_p = (p.ravel().astype(jnp.float32) + delta.reshape(-1)[: p.size])
@@ -291,4 +323,77 @@ class Zero1Adam:
         pick = lambda i: jax.tree.map(
             lambda _, o: o[i], params, out
         )
+        return pick(0), {"mu": pick(1), "nu": pick(2), "count": count}
+
+
+class FsdpAdam(Zero1Adam):
+    """ZeRO-3/FSDP AdamW for the LM engine: params AND both moments
+    persist only as data-axis-sharded ``[axis_size, chunk]`` flat
+    chunks — per-device persistent memory for params+moments drops from
+    3x params to 3x params / axis_size. The step gathers full params
+    just-in-time (one ``all_gather`` per leaf — the FSDP unshard; XLA
+    frees the full weights after their last use), and differentiating
+    THROUGH that gather makes the AD transpose — ``psum_scatter`` —
+    deliver gradients already summed over the axis and scattered to
+    this device's chunk; ``apply`` divides into the mean and runs the
+    optax-exact AdamW chunk rule from ``Zero1Adam``. No delta
+    all_gather: parameters stay sharded. Communication per step and
+    leaf: one all_gather (params) + one reduce-scatter (grad
+    cotangents) — the same total bytes as ZeRO-1's pair.
+
+    ``init``/chunk math inherit from ``Zero1Adam``; ``shard_params`` /
+    ``gather_params`` mirror ``FsdpSGD``'s layout (host-side global
+    ``[axis_size, chunk]`` shards; in-shard_map unshard needs the
+    original shape tree).
+    """
+
+    def shard_params(self, params):
+        """GLOBAL param tree -> ``[axis_size, chunk]`` flat shards."""
+        return _shard_flat(params, self.axis_size)
+
+    def gather_params(self, shards, shape_tree):
+        """Local ``[1, chunk]`` shards -> full params (``_gather_flat``)."""
+        return _gather_flat(shards, shape_tree, self.axis_name)
+
+    def unshard_host(self, shards, shape_tree):
+        """Host-side inverse of ``shard_params`` for export/decode: the
+        global ``[axis_size, chunk]`` arrays already hold every chunk —
+        reshape/slice, no collectives."""
+        import numpy as np
+
+        def leaf(sh, sds):
+            flat = np.asarray(jax.device_get(sh)).reshape(-1)
+            return flat[: math.prod(sds.shape)].reshape(sds.shape).astype(
+                np.asarray([], sds.dtype).dtype
+            )
+
+        return jax.tree.map(leaf, shards, shape_tree)
+
+    def apply(self, param_shards, state, grad_chunks):
+        """One FSDP AdamW step from CHUNKED grad sums (the ``[1, chunk]``
+        cotangents of ``gather_params`` — already psum_scattered by the
+        all_gather transpose): divide into means, optionally seq-pmean,
+        and run the shared AdamW chunk rule on the local shards."""
+        s = self.axis_size
+        count, lr, c1, c2 = self._step_scalars(state)
+
+        def leaf(psh, mu, nu, g):
+            chunk = psh.shape[-1]
+            g_mine = g.reshape(chunk).astype(jnp.float32) / s
+            if self.seq_axis is not None and self.seq_size > 1:
+                g_mine = lax.pmean(g_mine, self.seq_axis)
+            p_mine = psh.reshape(chunk).astype(jnp.float32)
+            mu_n, nu_n, update = self._adamw_chunk_update(
+                p_mine, mu.reshape(chunk), nu.reshape(chunk), g_mine, c1, c2
+            )
+            new_p = (p_mine - lr * update).astype(psh.dtype)
+            return (
+                new_p.reshape(1, chunk),
+                mu_n.reshape(1, chunk),
+                nu_n.reshape(1, chunk),
+            )
+
+        out = jax.tree.map(leaf, param_shards, state["mu"], state["nu"],
+                           grad_chunks)
+        pick = lambda i: jax.tree.map(lambda _, o: o[i], param_shards, out)
         return pick(0), {"mu": pick(1), "nu": pick(2), "count": count}

@@ -159,6 +159,16 @@ class LMConfig:
     # so resume needs the same data_parallel.
     zero1: bool = False
 
+    # ZeRO-3/FSDP (parallel/zero.py::FsdpAdam): params AND both AdamW
+    # moments persist only as data-axis-sharded flat chunks — 3x params
+    # of persistent state becomes 3x params / data_parallel per device.
+    # Full weights exist only transiently inside the step (one
+    # all_gather per leaf, freed after last use; the all_gather's AD
+    # transpose delivers grads pre-scattered). Same restrictions as
+    # zero1, same trajectory-parity guarantee; params leave fit() as
+    # chunked arrays (gather_for_decode unshards them).
+    fsdp: bool = False
+
     # Layer stacking (models/transformer.py::TransformerLM.scan_layers):
     # run the homogeneous blocks as one nn.scan body instead of L
     # unrolled copies — identical numerics, O(L) smaller traced program.
@@ -375,15 +385,21 @@ class LMTrainer:
             TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None,
             DATA_AXIS if self.expert_parallel else None,
         )
-        if cfg.zero1:
-            # ZeRO-1: chunked AdamW with data-axis-sharded moments
-            # (parallel/zero.py::Zero1Adam). The restrictions keep the
-            # flat-chunk layout uniform: every leaf must be data-
-            # replicated (no tensor/expert-sharded leaves whose LOCAL
-            # size differs from the global).
+        if cfg.zero1 and cfg.fsdp:
+            raise ValueError(
+                "zero1 and fsdp are mutually exclusive (fsdp subsumes "
+                "zero1's moment sharding and additionally shards params)"
+            )
+        if cfg.zero1 or cfg.fsdp:
+            # ZeRO: chunked AdamW with data-axis-sharded state
+            # (parallel/zero.py::Zero1Adam / FsdpAdam). The restrictions
+            # keep the flat-chunk layout uniform: every leaf must be
+            # data-replicated (no tensor/expert-sharded leaves whose
+            # LOCAL size differs from the global).
+            which = "fsdp" if cfg.fsdp else "zero1"
             for flag, bad, why in (
                 ("optimizer", cfg.optimizer != "adamw",
-                 "Zero1Adam implements the adamw rule"),
+                 "the chunked optimizer implements the adamw rule"),
                 ("tensor_parallel", self.tensor_size > 1,
                  "tensor-sharded leaves are not data-replicated"),
                 ("moe_expert_parallel", self.expert_parallel,
@@ -393,10 +409,11 @@ class LMTrainer:
             ):
                 if bad:
                     raise ValueError(
-                        f"zero1=True is incompatible with {flag} "
+                        f"{which}=True is incompatible with {flag} "
                         f"({why})"
                     )
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+                FsdpAdam,
                 Zero1Adam,
             )
             from cs744_pytorch_distributed_tutorial_tpu.train.state import (
@@ -404,7 +421,8 @@ class LMTrainer:
             )
 
             self.tx = None
-            self._zero1_opt = Zero1Adam(
+            opt_cls = FsdpAdam if cfg.fsdp else Zero1Adam
+            self._zero1_opt = opt_cls(
                 make_schedule(cfg), b1=cfg.momentum, b2=0.999, eps=1e-8,
                 weight_decay=cfg.weight_decay, axis_name=DATA_AXIS,
                 axis_size=self.data_size, seq_axis=SEQ_AXIS,
@@ -415,6 +433,13 @@ class LMTrainer:
                 "nu": jax.tree.map(lambda _: P(DATA_AXIS), param_shapes),
                 "count": P(),
             }
+            if cfg.fsdp:
+                # Params live as [dp, chunk] shards too: the original
+                # full shapes/dtypes are the unshard template.
+                self._param_shapes = param_shapes
+                self.param_specs = jax.tree.map(
+                    lambda _: P(DATA_AXIS), param_shapes
+                )
         else:
             self._zero1_opt = None
             self.tx = make_optimizer(cfg)
@@ -520,9 +545,14 @@ class LMTrainer:
         that mesh cannot mix with the decode program's mesh-free
         intermediates — while plain host arrays re-place under the
         decode jit's own defaults. The tensor-parallel path
-        (``tp_decode_model``) needs none of this."""
+        (``tp_decode_model``) needs none of this. FSDP-chunked params
+        unshard to the original shapes first (host math — the global
+        ``[dp, chunk]`` arrays already hold every chunk)."""
         from jax.sharding import NamedSharding
 
+        if self.cfg.fsdp:
+            # unshard_host is already host-side numpy (no collectives).
+            return self._zero1_opt.unshard_host(params, self._param_shapes)
         rep = NamedSharding(self.mesh, P())
         return jax.tree.map(
             lambda x: jax.device_get(jax.device_put(x, rep)), params
@@ -614,6 +644,13 @@ class LMTrainer:
         dropout = self.cfg.dropout_rate
         seed = self.cfg.seed
 
+        is_fsdp = self.cfg.fsdp
+        if is_fsdp:
+            shapes_tree = self._param_shapes
+            unshard = lambda ch: zero1_opt.gather_params(ch, shapes_tree)
+        else:
+            unshard = lambda p: p
+
         def local_step(params, opt_state, tokens, targets, step):
             # Dropout rng: keyed by (step, data index, seq index) — NOT
             # the tensor index: the MLP dropout applies to row-parallel
@@ -669,6 +706,13 @@ class LMTrainer:
                 )
                 return ce + aux_coef * aux, (aux, drop)
 
+            def diff_loss(p_or_chunks, toks, tgts, key):
+                # FSDP differentiates THROUGH the just-in-time unshard:
+                # the all_gather's transpose (psum_scatter) delivers the
+                # grads pre-scattered to each device's chunk. Identity
+                # otherwise.
+                return loss_fn(unshard(p_or_chunks), toks, tgts, key)
+
             # Differentiate the LOCAL loss, then average grads explicitly
             # per mesh axis. Under ``check_vma=False`` (which the
             # axis-index-routed attention collectives require) shard_map
@@ -685,7 +729,7 @@ class LMTrainer:
             # exact global mean.
             if accum == 1:
                 (local_loss, (aux, drop)), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
+                    diff_loss, has_aux=True
                 )(params, tokens, targets, drop_base)
             else:
                 # Gradient accumulation: scan over microbatches so only
@@ -698,7 +742,7 @@ class LMTrainer:
                 def body(carry, mb):
                     g_sum, l_sum, a_sum, d_sum = carry
                     (l, (a, dr)), g = jax.value_and_grad(
-                        loss_fn, has_aux=True
+                        diff_loss, has_aux=True
                     )(params, mb[0], mb[1], mb[2])
                     return (
                         jax.tree.map(jnp.add, g_sum, g),
@@ -766,7 +810,7 @@ class LMTrainer:
         self.jitted_train_step = mapped_step
 
         def local_eval(params, tokens, targets):
-            logits = model.apply({"params": params}, tokens)
+            logits = model.apply({"params": unshard(params)}, tokens)
             local = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
             ).mean()
@@ -800,6 +844,10 @@ class LMTrainer:
             if self._zero1_opt is not None
             else self.tx.init(params)
         )
+        if self.cfg.fsdp:
+            # Params live chunked from here on (the chunked
+            # self.param_specs lay them out below).
+            params = self._zero1_opt.shard_params(params)
         mesh = self.mesh
         params = jax.tree.map(
             lambda p, s: host_to_global(p, NamedSharding(mesh, s)),
