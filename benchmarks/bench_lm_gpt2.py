@@ -34,7 +34,8 @@ long-context/major-batch memory lever it was built as.
 
 Batch scaling, round-4 re-measurement (the round-3 "b16 no better"
 was a dots-only artifact):
-  flash + remat=OFF + b16  147.7 ms/step  110.9k tok/s  MFU 0.481  <- headline
+  flash + remat=OFF + b16  144.9 ms/step  113.0k tok/s  MFU 0.490  <- headline
+                           (first probe same day: 110.9k / 0.481)
   flash + remat=off + b24  239.7 ms/step  102.5k tok/s  MFU 0.445
   flash + remat=dots + b16  (round 3)      94.5k tok/s  MFU 0.41
 Batch 32 fails the tunnel's remote compile helper (HTTP 500) in EVERY
