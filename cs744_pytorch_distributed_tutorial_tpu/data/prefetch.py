@@ -32,6 +32,7 @@ class PrefetchIterator(Iterator[T]):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._exhausted = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._produce, args=(iter(iterable),), daemon=True
@@ -93,8 +94,15 @@ class PrefetchIterator(Iterator[T]):
         return self
 
     def __next__(self) -> T:
+        if self._exhausted:
+            # StopIteration must PERSIST (iterator protocol): the queue
+            # holds a single _STOP sentinel, so without this flag a
+            # retrying consumer's second next() would block forever on
+            # the empty queue.
+            raise StopIteration
         item = self._q.get()
         if item is _STOP:
+            self._exhausted = True
             raise StopIteration
         if isinstance(item, BaseException):
             raise item

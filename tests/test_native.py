@@ -133,3 +133,55 @@ def test_prefetch_runs_ahead():
         time.sleep(0.01)
     assert len(produced) >= 4  # ran ahead of the consumer
     it.close()
+
+
+def test_prefetch_terminates_after_relayed_exception():
+    """Round-4 review fix: a consumer that catches the relayed exception
+    and keeps reading must hit StopIteration, not block forever on the
+    empty queue (the producer enqueues _STOP after the exception)."""
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+    with pytest.raises(StopIteration):
+        next(it)  # must terminate, not hang
+    with pytest.raises(StopIteration):
+        next(it)  # and KEEP terminating (iterator protocol)
+
+
+def test_prefetch_materializes_on_producer_thread(monkeypatch):
+    """Round-4 fix: _block_ready runs ON THE PRODUCER THREAD (one-behind
+    blocking; the final item fenced before _STOP) — recorded by
+    monkeypatching jax.block_until_ready and asserting the calling
+    thread and the fenced items."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    calls = []
+    real = jax.block_until_ready
+
+    def recording(x):
+        calls.append(threading.current_thread())
+        return real(x)
+
+    monkeypatch.setattr(jax, "block_until_ready", recording)
+
+    def gen():
+        for i in range(6):
+            yield jnp.arange(4) * i  # dispatched lazily
+
+    out = list(prefetch(gen(), depth=2))
+    assert len(out) == 6
+    assert int(out[-1][-1]) == 15
+    # Every fence ran off the main thread (the producer daemon), and
+    # every item was fenced (one-behind: 6 items = 6 calls incl. the
+    # final pre-_STOP fence).
+    main = threading.main_thread()
+    producer_calls = [t for t in calls if t is not main]
+    assert len(producer_calls) >= 6, (len(calls), len(producer_calls))
