@@ -580,9 +580,7 @@ def main(argv: list[str] | None = None) -> int:
                 k=args.speculative_k,
                 temperature=args.temperature,
             )
-            spec_args = (
-                host_params, jax.device_get(draft_params), prompt_arr[:1]
-            )
+            spec_args = (host_params, draft_host, prompt_arr[:1])
             if args.temperature > 0.0:
                 # Rejection-sampling mode draws from the target
                 # distribution — it needs the run's rng key.

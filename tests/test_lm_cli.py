@@ -196,3 +196,18 @@ def test_lm_cli_speculative_decode(capsys):
             "--vocab-size", "32", "--generate", "4", "--speculative-k", "2",
             "--temperature", "0.8", "--top-k", "4",
         ])
+
+
+def test_lm_cli_speculative_decode_with_fsdp(capsys):
+    # --fsdp leaves both target and draft params in chunked [dp, chunk]
+    # layout; the decode path must unshard BOTH (ADVICE r4: the draft's
+    # unshard result was computed but not passed to the generator).
+    rc = main(TINY + [
+        "--vocab-size", "32", "--data-parallel", "2", "--fsdp",
+        "--generate", "6", "--prompt-len", "4", "--temperature", "0",
+        "--speculative-k", "2", "--draft-layers", "1", "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert len(summary["sample"]) == 6
+    assert all(0 <= t < 32 for t in summary["sample"])
