@@ -129,13 +129,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard both AdamW moments over the data "
                         "axis (optimizer memory / data_parallel); "
-                        "requires adamw, tensor-parallel 1, no expert "
-                        "parallelism, no grad clipping")
+                        "composes with --tensor-parallel and "
+                        "--grad-clip-norm; requires adamw, no expert "
+                        "parallelism")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3/FSDP: params AND AdamW moments persist "
                         "as data-axis-sharded chunks, gathered "
                         "just-in-time per step (3x-params state / "
-                        "data_parallel); same restrictions as --zero1")
+                        "data_parallel); same compositions and "
+                        "restrictions as --zero1")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
     p.add_argument("--checkpoint-dir", default=None)

@@ -30,7 +30,39 @@ import math
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
+
+def spec_axes(spec) -> tuple:
+    """Ordered mesh-axis names a PartitionSpec shards over (deduped;
+    nested tuples flattened). ``P()``/``None`` -> ``()``."""
+    axes: list = []
+    for entry in tuple(spec) if spec is not None else ():
+        if entry is None:
+            continue
+        for name in entry if isinstance(entry, (tuple, list)) else (entry,):
+            if name not in axes:
+                axes.append(name)
+    return tuple(axes)
+
+
+def spec_dim(spec, axis: str | None) -> int | None:
+    """Index of the dim ``spec`` shards over ``axis`` (None if absent —
+    the leaf is replicated over that mesh axis)."""
+    if spec is None or axis is None:
+        return None
+    for i, entry in enumerate(tuple(spec)):
+        if entry == axis or (
+            isinstance(entry, (tuple, list)) and axis in entry
+        ):
+            return i
+    return None
+
+
+def _replicated_specs(params):
+    """An all-``P()`` spec tree matching ``params`` (the default when the
+    caller has no tensor axis)."""
+    return jax.tree.map(lambda _: P(), params)
 
 
 def _shard_flat(params, axis_size: int):
@@ -216,6 +248,24 @@ class Zero1Adam:
     trainer shards dim 0 over the data axis); ``apply`` runs inside
     ``shard_map`` where each moment leaf arrives as its ``[1, chunk]``
     local shard and params arrive replicated.
+
+    Tensor-parallel composition (round 5): with ``tensor_axis`` set,
+    leaves whose PartitionSpec names that axis are chunked PER
+    (data, tensor) coordinate — each tensor shard's LOCAL flat view
+    splits over the data axis independently, so moments live as
+    ``[axis_size, tensor_size, chunk]`` globally (sharded over both
+    axes) and the in-shard_map math is unchanged: inside shard_map a
+    leaf's "size" IS its local tensor-shard size, and the
+    psum_scatter / all_gather pair runs within the tensor coordinate.
+    Replicated leaves additionally get a tensor-axis pmean drift guard
+    on their chunk (their grads are already identical across tensor
+    shards — the Megatron f-boundary psum).
+
+    Gradient clipping (round 5): ``clip_norm`` applies optax's
+    clip_by_global_norm rule to the scattered chunks using the EXACT
+    global norm — one psum over (data, tensor) of per-device squared
+    sums, with replicated leaves' contribution divided by tensor_size
+    so every global element counts exactly once.
     """
 
     def __init__(
@@ -229,6 +279,9 @@ class Zero1Adam:
         axis_size: int,
         seq_axis: str | None = None,
         seq_size: int = 1,
+        tensor_axis: str | None = None,
+        tensor_size: int = 1,
+        clip_norm: float | None = None,
     ):
         self.schedule = schedule
         self.b1, self.b2, self.eps = b1, b2, eps
@@ -237,17 +290,36 @@ class Zero1Adam:
         self.axis_size = axis_size
         self.seq_axis = seq_axis
         self.seq_size = seq_size
+        self.tensor_axis = tensor_axis if tensor_size > 1 else None
+        self.tensor_size = tensor_size if tensor_size > 1 else 1
+        self.clip_norm = clip_norm
 
     def _chunk(self, size: int) -> int:
         return -(-size // self.axis_size)  # ceil
 
-    def init(self, params):
-        moment = lambda: jax.tree.map(
-            lambda p: jnp.zeros(
-                (self.axis_size, self._chunk(p.size)), jnp.float32
-            ),
-            params,
-        )
+    def _tp_dim(self, spec) -> int | None:
+        return spec_dim(spec, self.tensor_axis)
+
+    def init(self, params, specs=None):
+        """Host-side global moment zeros: ``[axis_size, chunk]`` per
+        replicated leaf, ``[axis_size, tensor_size, chunk]`` per
+        tensor-sharded leaf (``specs`` = the param PartitionSpec tree;
+        chunk = ceil(LOCAL leaf size / axis_size))."""
+        if specs is None:
+            specs = _replicated_specs(params)
+
+        def leaf(p, spec):
+            if self._tp_dim(spec) is None:
+                return jnp.zeros(
+                    (self.axis_size, self._chunk(p.size)), jnp.float32
+                )
+            local = p.size // self.tensor_size
+            return jnp.zeros(
+                (self.axis_size, self.tensor_size, self._chunk(local)),
+                jnp.float32,
+            )
+
+        moment = lambda: jax.tree.map(leaf, params, specs)
         return {
             "mu": moment(),
             "nu": moment(),
@@ -281,26 +353,74 @@ class Zero1Adam:
         )
         return mu_n, nu_n, update
 
-    def apply(self, params, state, grads):
+    def _mean_chunk(self, g, spec):
+        """Inside shard_map: LOCAL (pre-sync) grad leaf -> this device's
+        f32 chunk of the data-mean gradient. The psum_scatter IS the
+        data reduction (half an allreduce's bytes, pre-sharded); seq
+        replicas average on the chunk; replicated-over-tensor leaves get
+        the tensor drift-guard pmean (their grads are already identical
+        across tensor shards)."""
+        s = self.axis_size
+        chunk = self._chunk(g.size)  # g.size = LOCAL tensor-shard size
+        pad = s * chunk - g.size
+        g2d = jnp.pad(g.ravel().astype(jnp.float32), (0, pad)).reshape(
+            s, chunk
+        )
+        g_mine = (
+            lax.psum_scatter(g2d, self.axis_name, scatter_dimension=0) / s
+        )
+        if self.seq_axis is not None and self.seq_size > 1:
+            g_mine = lax.pmean(g_mine, self.seq_axis)
+        if self.tensor_axis is not None and self._tp_dim(spec) is None:
+            g_mine = lax.pmean(g_mine, self.tensor_axis)
+        return g_mine
+
+    def _clip_chunks(self, chunks, specs):
+        """optax.clip_by_global_norm's rule on the scattered mean-grad
+        chunks, with the EXACT global norm: chunks of tensor-sharded
+        leaves partition their elements over (data, tensor) and count
+        once; replicated leaves' chunks repeat per tensor coordinate, so
+        their squared sum is pre-divided by tensor_size. One psum over
+        (data [, tensor]) yields the same norm on every device (seq
+        replicas already hold identical chunks — no seq psum). Padding
+        contributes zeros."""
+        if self.clip_norm is None:
+            return chunks
+        tp = self.tensor_size
+
+        def leaf_sq(g, spec):
+            sq = jnp.sum(g * g)
+            return sq if self._tp_dim(spec) is not None else sq / tp
+
+        local = sum(
+            jax.tree.leaves(jax.tree.map(leaf_sq, chunks, specs)),
+            start=jnp.float32(0.0),
+        )
+        axes = (self.axis_name,) + (
+            (self.tensor_axis,) if self.tensor_axis is not None else ()
+        )
+        g_norm = jnp.sqrt(lax.psum(local, axes))
+        trigger = g_norm < self.clip_norm
+        scale = self.clip_norm / g_norm
+        return jax.tree.map(
+            lambda t: lax.select(trigger, t, t * scale), chunks
+        )
+
+    def apply(self, params, state, grads, specs=None):
         """One ZeRO-1 AdamW step from LOCAL (pre-sync) grads: returns
-        (replicated new params, new state with local moment shards)."""
+        (replicated new params, new state with local moment shards).
+        ``specs`` is the param PartitionSpec tree (tensor-sharded leaves
+        chunk their LOCAL shard; omit for all-replicated)."""
         s = self.axis_size
         count, lr, c1, c2 = self._step_scalars(state)
+        if specs is None:
+            specs = _replicated_specs(params)
+        chunks = jax.tree.map(self._mean_chunk, grads, specs)
+        chunks = self._clip_chunks(chunks, specs)
 
-        def leaf(p, mu, nu, g):
-            chunk = self._chunk(p.size)
+        def leaf(p, mu, nu, g_mine):
+            chunk = g_mine.shape[-1]
             pad = s * chunk - p.size
-            g2d = jnp.pad(
-                g.ravel().astype(jnp.float32), (0, pad)
-            ).reshape(s, chunk)
-            # Reduce-scatter the SUM, divide: this device's chunk of the
-            # data-mean gradient; seq replicas then average on the chunk.
-            g_mine = (
-                lax.psum_scatter(g2d, self.axis_name, scatter_dimension=0)
-                / s
-            )
-            if self.seq_axis is not None and self.seq_size > 1:
-                g_mine = lax.pmean(g_mine, self.seq_axis)
             p2d = jnp.pad(
                 p.ravel().astype(jnp.float32), (0, pad)
             ).reshape(s, chunk)
@@ -315,11 +435,11 @@ class Zero1Adam:
             new_p = (p.ravel().astype(jnp.float32) + delta.reshape(-1)[: p.size])
             return (
                 new_p.reshape(p.shape).astype(p.dtype),
-                mu_n.reshape(1, chunk),
-                nu_n.reshape(1, chunk),
+                mu_n.reshape(mu.shape),
+                nu_n.reshape(nu.shape),
             )
 
-        out = jax.tree.map(leaf, params, state["mu"], state["nu"], grads)
+        out = jax.tree.map(leaf, params, state["mu"], state["nu"], chunks)
         pick = lambda i: jax.tree.map(
             lambda _, o: o[i], params, out
         )
@@ -345,55 +465,116 @@ class FsdpAdam(Zero1Adam):
     ``gather_params`` mirror ``FsdpSGD``'s layout (host-side global
     ``[axis_size, chunk]`` shards; in-shard_map unshard needs the
     original shape tree).
+
+    Tensor-parallel composition (round 5): tensor-sharded leaves chunk
+    each LOCAL tensor shard independently — host layout
+    ``[axis_size, tensor_size, chunk]`` (sharded over data AND tensor),
+    the in-shard_map unshard reconstructs the LOCAL tensor shard (so
+    ``gather_params`` takes the LOCAL shape tree), and ``unshard_host``
+    reassembles the global leaf by concatenating the per-tensor-shard
+    pieces along the sharded dim.
     """
 
-    def shard_params(self, params):
-        """GLOBAL param tree -> ``[axis_size, chunk]`` flat shards."""
-        return _shard_flat(params, self.axis_size)
+    def shard_params(self, params, specs=None):
+        """GLOBAL param tree -> flat chunked shards: ``[axis_size,
+        chunk]`` per replicated leaf, ``[axis_size, tensor_size, chunk]``
+        per tensor-sharded leaf (each tensor shard's flat view chunked
+        over the data axis independently)."""
+        if specs is None:
+            specs = _replicated_specs(params)
 
-    def gather_params(self, shards, shape_tree):
-        """Local ``[1, chunk]`` shards -> full params (``_gather_flat``)."""
-        return _gather_flat(shards, shape_tree, self.axis_name)
+        def rows(x):
+            # flat local view -> zero-padded [axis_size, chunk]
+            chunk = self._chunk(x.size)
+            return jnp.pad(
+                x.ravel(), (0, self.axis_size * chunk - x.size)
+            ).reshape(self.axis_size, chunk)
 
-    def unshard_host(self, shards, shape_tree):
-        """Host-side inverse of ``shard_params`` for export/decode: the
-        global ``[axis_size, chunk]`` arrays already hold every chunk —
-        reshape/slice, no collectives."""
-        import numpy as np
-
-        def leaf(sh, sds):
-            flat = np.asarray(jax.device_get(sh)).reshape(-1)
-            return flat[: math.prod(sds.shape)].reshape(sds.shape).astype(
-                np.asarray([], sds.dtype).dtype
+        def leaf(p, spec):
+            k = self._tp_dim(spec)
+            if k is None:
+                return rows(p)
+            return jnp.stack(
+                [rows(sh) for sh in jnp.split(p, self.tensor_size, axis=k)],
+                axis=1,
             )
 
-        return jax.tree.map(leaf, shards, shape_tree)
+        return jax.tree.map(leaf, params, specs)
 
-    def apply(self, param_shards, state, grad_chunks):
-        """One FSDP AdamW step from CHUNKED grad sums (the ``[1, chunk]``
-        cotangents of ``gather_params`` — already psum_scattered by the
-        all_gather transpose): divide into means, optionally seq-pmean,
-        and run the shared AdamW chunk rule on the local shards."""
-        s = self.axis_size
+    def gather_params(self, shards, shape_tree):
+        """Local ``[1, (1,) chunk]`` shards -> LOCAL params (one
+        all_gather over the data axis per leaf). ``shape_tree`` carries
+        the PER-DEVICE shapes: global shapes for replicated leaves, the
+        tensor-shard shapes for tensor-sharded leaves (the trainer
+        precomputes this local tree)."""
+        return _gather_flat(shards, shape_tree, self.axis_name)
+
+    def unshard_host(self, shards, shape_tree, specs=None):
+        """Host-side inverse of ``shard_params`` for export/decode: the
+        global chunked arrays already hold every chunk — reshape/slice
+        (+ concat over tensor shards), no collectives."""
+        import numpy as np
+
+        if specs is None:
+            specs = _replicated_specs(shape_tree)
+
+        def leaf(sh, sds, spec):
+            flat = np.asarray(jax.device_get(sh))
+            dtype = np.asarray([], sds.dtype).dtype
+            k = self._tp_dim(spec)
+            if k is None:
+                return (
+                    flat.reshape(-1)[: math.prod(sds.shape)]
+                    .reshape(sds.shape)
+                    .astype(dtype)
+                )
+            local_shape = list(sds.shape)
+            local_shape[k] //= self.tensor_size
+            local_size = math.prod(local_shape)
+            parts = [
+                flat[:, t, :].reshape(-1)[:local_size].reshape(local_shape)
+                for t in range(self.tensor_size)
+            ]
+            return np.concatenate(parts, axis=k).astype(dtype)
+
+        return jax.tree.map(leaf, shards, shape_tree, specs)
+
+    def _mean_chunk(self, g, spec):
+        """FSDP grads arrive pre-scattered (the ``[1, (1,) chunk]``
+        cotangents of ``gather_params`` — the all_gather transpose
+        already psum_scattered the data-axis SUM): divide into the mean,
+        seq-pmean, tensor drift guard for replicated leaves."""
+        g_mine = g.reshape(-1).astype(jnp.float32) / self.axis_size
+        if self.seq_axis is not None and self.seq_size > 1:
+            g_mine = lax.pmean(g_mine, self.seq_axis)
+        if self.tensor_axis is not None and self._tp_dim(spec) is None:
+            g_mine = lax.pmean(g_mine, self.tensor_axis)
+        return g_mine
+
+    def apply(self, param_shards, state, grad_chunks, specs=None):
+        """One FSDP AdamW step from CHUNKED grad sums: mean-ify (and
+        optionally clip, ``_clip_chunks``) the chunks, then run the
+        shared AdamW chunk rule on the local shards."""
         count, lr, c1, c2 = self._step_scalars(state)
+        if specs is None:
+            specs = _replicated_specs(param_shards)
+        chunks = jax.tree.map(self._mean_chunk, grad_chunks, specs)
+        chunks = self._clip_chunks(chunks, specs)
 
-        def leaf(psh, mu, nu, g):
+        def leaf(psh, mu, nu, g_mine):
             chunk = psh.shape[-1]
-            g_mine = g.reshape(chunk).astype(jnp.float32) / s
-            if self.seq_axis is not None and self.seq_size > 1:
-                g_mine = lax.pmean(g_mine, self.seq_axis)
             p_mine = psh.reshape(chunk).astype(jnp.float32)
             mu_n, nu_n, update = self._adamw_chunk_update(
                 p_mine, mu.reshape(chunk), nu.reshape(chunk), g_mine, c1, c2
             )
             new_p = (p_mine - lr * update).astype(psh.dtype)
             return (
-                new_p.reshape(1, chunk),
-                mu_n.reshape(1, chunk),
-                nu_n.reshape(1, chunk),
+                new_p.reshape(psh.shape),
+                mu_n.reshape(mu.shape),
+                nu_n.reshape(nu.shape),
             )
 
         out = jax.tree.map(leaf, param_shards, state["mu"], state["nu"],
-                           grad_chunks)
+                           chunks)
         pick = lambda i: jax.tree.map(lambda _, o: o[i], param_shards, out)
         return pick(0), {"mu": pick(1), "nu": pick(2), "count": count}

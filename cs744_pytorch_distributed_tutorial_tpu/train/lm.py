@@ -154,9 +154,11 @@ class LMConfig:
     # (half an allreduce's bytes) and parameter deltas all_gather back —
     # the same total bytes as the allreduce it replaces. Trajectory
     # matches the replicated optimizer to float tolerance (tested).
-    # Requires optimizer="adamw", tensor_parallel=1, no expert
-    # parallelism, no grad clipping; checkpoints carry the chunk layout,
-    # so resume needs the same data_parallel.
+    # Composes with tensor_parallel (local tensor shards chunk per
+    # (data, tensor) coordinate) and grad_clip_norm (exact global norm
+    # via one psum of per-chunk squared sums). Requires
+    # optimizer="adamw" and no expert parallelism; checkpoints carry
+    # the chunk layout, so resume needs the same data_parallel.
     zero1: bool = False
 
     # ZeRO-3/FSDP (parallel/zero.py::FsdpAdam): params AND both AdamW
@@ -355,18 +357,12 @@ class LMTrainer:
             mlp=cfg.mlp,
             scan_layers=cfg.scan_layers,
         )
-        if cfg.grad_clip_norm is not None and (
-            self.tensor_size > 1 or self.expert_parallel
-        ):
-            # The clip transform computes the norm over each device's
-            # LOCAL grads inside shard_map; with tensor- or expert-
-            # sharded params that norm is incomplete AND device-varying
-            # (a replication-divergence bug, not just a wrong bound).
-            raise ValueError(
-                "grad_clip_norm requires fully replicated gradients; "
-                f"got tensor_parallel={self.tensor_size}, "
-                f"expert_parallel={self.expert_parallel}"
-            )
+        # grad_clip_norm composes with tensor/expert sharding via the
+        # spec-aware clip (train/state.py::clip_by_global_norm_sharded):
+        # plain optax clip would compute each device's LOCAL norm inside
+        # shard_map — incomplete AND device-varying over sharded leaves
+        # (a replication-divergence bug) — so the sharded transform
+        # psums each leaf's squared-sum over the axes its spec names.
         # The shared optimizer/schedule registry (train/state.py) reads
         # the same field names LMConfig defines — duck-typed on purpose.
         from cs744_pytorch_distributed_tutorial_tpu.train.state import (
@@ -392,20 +388,17 @@ class LMTrainer:
             )
         if cfg.zero1 or cfg.fsdp:
             # ZeRO: chunked AdamW with data-axis-sharded state
-            # (parallel/zero.py::Zero1Adam / FsdpAdam). The restrictions
-            # keep the flat-chunk layout uniform: every leaf must be
-            # data-replicated (no tensor/expert-sharded leaves whose
-            # LOCAL size differs from the global).
+            # (parallel/zero.py::Zero1Adam / FsdpAdam). Tensor-sharded
+            # leaves chunk their LOCAL shard per (data, tensor)
+            # coordinate (round 5); expert-sharded leaves remain out —
+            # their all_to_all grad layout doesn't fit the flat-chunk
+            # scatter.
             which = "fsdp" if cfg.fsdp else "zero1"
             for flag, bad, why in (
                 ("optimizer", cfg.optimizer != "adamw",
                  "the chunked optimizer implements the adamw rule"),
-                ("tensor_parallel", self.tensor_size > 1,
-                 "tensor-sharded leaves are not data-replicated"),
                 ("moe_expert_parallel", self.expert_parallel,
                  "expert-sharded leaves are not data-replicated"),
-                ("grad_clip_norm", cfg.grad_clip_norm is not None,
-                 "the global norm is unavailable over scattered chunks"),
             ):
                 if bad:
                     raise ValueError(
@@ -415,6 +408,7 @@ class LMTrainer:
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpAdam,
                 Zero1Adam,
+                spec_dim,
             )
             from cs744_pytorch_distributed_tutorial_tpu.train.state import (
                 make_schedule,
@@ -427,22 +421,70 @@ class LMTrainer:
                 weight_decay=cfg.weight_decay, axis_name=DATA_AXIS,
                 axis_size=self.data_size, seq_axis=SEQ_AXIS,
                 seq_size=self.seq_size,
+                tensor_axis=(
+                    TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None
+                ),
+                tensor_size=self.tensor_size,
+                clip_norm=cfg.grad_clip_norm,
+            )
+            # The original (tensor-aware) specs drive the chunk layout;
+            # chunked leaves shard [dp, chunk] over data or
+            # [dp, tp, chunk] over (data, tensor).
+            self._orig_param_specs = self.param_specs
+
+            def chunk_spec(_, spec):
+                if (
+                    self.tensor_size > 1
+                    and spec_dim(spec, TENSOR_AXIS) is not None
+                ):
+                    return P(DATA_AXIS, TENSOR_AXIS)
+                return P(DATA_AXIS)
+
+            moment_specs = jax.tree.map(
+                chunk_spec, param_shapes, self._orig_param_specs
             )
             self.opt_specs = {
-                "mu": jax.tree.map(lambda _: P(DATA_AXIS), param_shapes),
-                "nu": jax.tree.map(lambda _: P(DATA_AXIS), param_shapes),
+                "mu": moment_specs,
+                "nu": moment_specs,
                 "count": P(),
             }
             if cfg.fsdp:
-                # Params live as [dp, chunk] shards too: the original
-                # full shapes/dtypes are the unshard template.
+                # Params live as flat chunked shards too: the original
+                # full shapes/dtypes are the unshard template, and the
+                # LOCAL shapes (tensor dim divided) template the
+                # in-shard_map gather.
                 self._param_shapes = param_shapes
-                self.param_specs = jax.tree.map(
-                    lambda _: P(DATA_AXIS), param_shapes
+
+                def local_shape(sh, spec):
+                    k = spec_dim(spec, TENSOR_AXIS)
+                    if k is None or self.tensor_size == 1:
+                        return sh
+                    dims = list(sh.shape)
+                    dims[k] //= self.tensor_size
+                    return jax.ShapeDtypeStruct(tuple(dims), sh.dtype)
+
+                self._local_param_shapes = jax.tree.map(
+                    local_shape, param_shapes, self._orig_param_specs
                 )
+                self.param_specs = moment_specs
         else:
             self._zero1_opt = None
-            self.tx = make_optimizer(cfg)
+            self._orig_param_specs = self.param_specs
+            if cfg.grad_clip_norm is not None and (
+                self.tensor_size > 1 or self.expert_parallel
+            ):
+                from cs744_pytorch_distributed_tutorial_tpu.train.state import (
+                    clip_by_global_norm_sharded,
+                )
+
+                self.tx = optax.chain(
+                    clip_by_global_norm_sharded(
+                        cfg.grad_clip_norm, self.param_specs
+                    ),
+                    make_optimizer(cfg.replace(grad_clip_norm=None)),
+                )
+            else:
+                self.tx = make_optimizer(cfg)
             self.opt_specs = optax.tree_map_params(
                 self.tx,
                 lambda _, spec: spec,
@@ -551,8 +593,11 @@ class LMTrainer:
         from jax.sharding import NamedSharding
 
         if self.cfg.fsdp:
-            # unshard_host is already host-side numpy (no collectives).
-            return self._zero1_opt.unshard_host(params, self._param_shapes)
+            # unshard_host is already host-side numpy (no collectives);
+            # tensor-sharded leaves reassemble from their per-shard rows.
+            return self._zero1_opt.unshard_host(
+                params, self._param_shapes, self._orig_param_specs
+            )
         rep = NamedSharding(self.mesh, P())
         return jax.tree.map(
             lambda x: jax.device_get(jax.device_put(x, rep)), params
@@ -575,6 +620,13 @@ class LMTrainer:
             raise ValueError(
                 "tp_decode_model does not support expert parallelism; "
                 "decode EP models from gathered params (decode_model)"
+            )
+        if self.cfg.fsdp:
+            raise ValueError(
+                "tp_decode_model does not apply to fsdp-chunked params "
+                "(they are flat [dp(, tp), chunk] shards, not the "
+                "tensor-sharded layout this model expects); use "
+                "gather_for_decode + decode_model"
             )
         return self.model.clone(
             seq_axis=None,
@@ -646,10 +698,14 @@ class LMTrainer:
 
         is_fsdp = self.cfg.fsdp
         if is_fsdp:
-            shapes_tree = self._param_shapes
+            # gather_params reconstructs each device's LOCAL view: the
+            # full leaf for replicated params, the tensor shard for
+            # tensor-sharded ones.
+            shapes_tree = self._local_param_shapes
             unshard = lambda ch: zero1_opt.gather_params(ch, shapes_tree)
         else:
             unshard = lambda p: p
+        orig_specs = self._orig_param_specs
 
         def local_step(params, opt_state, tokens, targets, step):
             # Dropout rng: keyed by (step, data index, seq index) — NOT
@@ -764,9 +820,12 @@ class LMTrainer:
                 # ZeRO-1 consumes the RAW local grads: its per-leaf
                 # psum_scatter IS the data-axis reduction (half an
                 # allreduce's bytes, delivered pre-sharded) and the seq
-                # pmean runs on the 1/dp chunk inside.
+                # pmean runs on the 1/dp chunk inside. The original
+                # specs tell it which leaves are tensor shards (chunked
+                # per (data, tensor) coordinate) and drive the exact
+                # global-norm clip when configured.
                 params, opt_state = zero1_opt.apply(
-                    params, opt_state, grads
+                    params, opt_state, grads, orig_specs
                 )
             else:
                 grads = jax.tree.map(sync_grad, grads, param_specs)
@@ -840,14 +899,16 @@ class LMTrainer:
         )
         params = variables["params"]
         opt_state = (
-            self._zero1_opt.init(params)
+            self._zero1_opt.init(params, self._orig_param_specs)
             if self._zero1_opt is not None
             else self.tx.init(params)
         )
         if self.cfg.fsdp:
             # Params live chunked from here on (the chunked
             # self.param_specs lay them out below).
-            params = self._zero1_opt.shard_params(params)
+            params = self._zero1_opt.shard_params(
+                params, self._orig_param_specs
+            )
         mesh = self.mesh
         params = jax.tree.map(
             lambda p, s: host_to_global(p, NamedSharding(mesh, s)),

