@@ -229,15 +229,16 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
     hand-scheduled 1F1B backward. Since the round-3 promotion the engine
     composes with tensor parallelism, RoPE, GQA, flash, remat, MoE
     expert parallelism, the optimizer/schedule registry, bfloat16,
-    checkpoint/resume, and held-out eval; the remaining rejections below
-    are the features the pipeline schedules genuinely cannot express."""
+    checkpoint/resume, and held-out eval; round 5 adds --zero1
+    (data-sharded AdamW moments chunked per (pipe, tensor) coordinate)
+    and --grad-clip-norm (spec-aware exact global norm). The remaining
+    rejections below are the features the pipeline schedules genuinely
+    cannot express."""
     import math
 
     # Flags the pipeline engine cannot express are rejected — a silently
     # dropped option would train a different configuration than asked.
     for flag, val, default, why in (
-        ("--zero1", args.zero1, False,
-         "sharded-moment AdamW lives on the shard_map engine"),
         ("--fsdp", args.fsdp, False,
          "chunk-sharded params live on the shard_map engine"),
         ("--generate", args.generate, 0,
@@ -246,8 +247,6 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
          "decode runs on the shard_map engine"),
         ("--accum-steps", args.accum_steps, 1,
          "microbatching IS the pipeline's accumulation"),
-        ("--grad-clip-norm", args.grad_clip_norm, None,
-         "pipe-stage-sharded grads have no global norm"),
         ("--label-smoothing", args.label_smoothing, 0.0,
          "the pipeline tail computes plain CE"),
         ("--fused-xent", args.fused_xent, False,
@@ -335,6 +334,8 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         warmup_steps=args.warmup_steps,
         total_steps=args.steps,
         weight_decay=args.weight_decay,
+        grad_clip_norm=args.grad_clip_norm,
+        zero1=args.zero1,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         halt_on_nonfinite=args.halt_on_nonfinite,

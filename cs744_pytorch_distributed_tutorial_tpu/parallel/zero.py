@@ -65,6 +65,92 @@ def _replicated_specs(params):
     return jax.tree.map(lambda _: P(), params)
 
 
+def rechunk_elastic(saved, like, local_size: int):
+    """Host-side mesh-elastic re-chunk of flat ZeRO state:
+    ``[dp_old, *mid, chunk_old]`` -> ``[dp_new, *mid, chunk_new]``.
+    Per middle (model-shard) coordinate: concatenate the dp_old chunks,
+    drop the zero padding at ``local_size`` (the true flat length of
+    that coordinate's shard), and re-pad into dp_new chunks. The middle
+    dims are layout-pinned — the model-parallel axes must match the
+    save — and the saved chunking must be consistent with
+    ``local_size`` (chunk_old == ceil(local_size / dp_old)): a
+    mismatch means the MODEL changed since the save, and slicing stale
+    flat state would silently resume from garbage."""
+    import numpy as np
+
+    if saved.shape[1:-1] != like.shape[1:-1]:
+        raise ValueError(
+            "ZeRO resume cannot re-chunk across model-shard axes "
+            f"(saved middle dims {saved.shape[1:-1]}, "
+            f"now {like.shape[1:-1]})"
+        )
+    if saved.shape[-1] != -(-local_size // saved.shape[0]):
+        raise ValueError(
+            f"saved chunking [dp={saved.shape[0]}, chunk={saved.shape[-1]}] "
+            f"is inconsistent with the current leaf's local size "
+            f"{local_size} (expected chunk "
+            f"{-(-local_size // saved.shape[0])}) — the model shape "
+            "changed since the save; only data_parallel may differ"
+        )
+    mid = math.prod(saved.shape[1:-1])
+    s3 = saved.reshape(saved.shape[0], mid, saved.shape[-1])
+    dp_new, c_new = like.shape[0], like.shape[-1]
+    out = np.zeros((dp_new, mid, c_new), saved.dtype)
+    for t in range(mid):
+        flat = s3[:, t, :].reshape(-1)[:local_size]
+        out[:, t, :] = np.pad(
+            flat, (0, dp_new * c_new - local_size)
+        ).reshape(dp_new, c_new)
+    return out.reshape(like.shape)
+
+
+def chunk_local_sizes(param_shapes, specs, shard_axes: dict) -> dict:
+    """Path-keyed UNPADDED local flat sizes for the elastic re-chunk:
+    each param leaf's element count divided by the sizes of the
+    ``shard_axes`` its PartitionSpec names (the per-coordinate shard
+    length the chunk layout was built from)."""
+    from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
+        _path_key,
+    )
+
+    shape_leaves = jax.tree_util.tree_flatten_with_path(param_shapes)[0]
+    spec_leaves = jax.tree_util.tree_structure(param_shapes).flatten_up_to(
+        specs
+    )
+    return {
+        _path_key(path): leaf.size
+        // math.prod(
+            n for a, n in shard_axes.items() if spec_dim(spec, a) is not None
+        )
+        for (path, leaf), spec in zip(shape_leaves, spec_leaves)
+    }
+
+
+def make_elastic_adapt(
+    local_sizes: dict,
+    prefixes: tuple = ("opt_state/mu/", "opt_state/nu/"),
+):
+    """Per-leaf ``adapt`` callback for
+    ``Checkpointer.restore_latest``: leaves under one of ``prefixes``
+    (the flat-chunked collections — moments, and fsdp's ``params/``)
+    re-chunk across data_parallel sizes via ``rechunk_elastic``; every
+    other leaf falls through (None) to the default slice/tile."""
+
+    def adapt(path_key: str, saved, like):
+        for prefix in prefixes:
+            if path_key.startswith(prefix):
+                suffix = path_key[len(prefix):]
+                break
+        else:
+            return None
+        local_size = local_sizes.get(suffix)
+        if local_size is None or saved.ndim != like.ndim:
+            return None
+        return rechunk_elastic(saved, like, local_size)
+
+    return adapt
+
+
 def _shard_flat(params, axis_size: int):
     """GLOBAL param tree -> ``[axis_size, chunk]`` zero-padded flat
     shards (the shared ZeRO-3 layout; host-side)."""
@@ -249,23 +335,26 @@ class Zero1Adam:
     ``shard_map`` where each moment leaf arrives as its ``[1, chunk]``
     local shard and params arrive replicated.
 
-    Tensor-parallel composition (round 5): with ``tensor_axis`` set,
-    leaves whose PartitionSpec names that axis are chunked PER
-    (data, tensor) coordinate — each tensor shard's LOCAL flat view
-    splits over the data axis independently, so moments live as
-    ``[axis_size, tensor_size, chunk]`` globally (sharded over both
-    axes) and the in-shard_map math is unchanged: inside shard_map a
-    leaf's "size" IS its local tensor-shard size, and the
-    psum_scatter / all_gather pair runs within the tensor coordinate.
-    Replicated leaves additionally get a tensor-axis pmean drift guard
-    on their chunk (their grads are already identical across tensor
-    shards — the Megatron f-boundary psum).
+    Model-shard composition (round 5): with ``shard_axes`` set (mesh
+    axis name -> size; e.g. the LM engine's ``{"tensor": t}`` or the
+    pipeline engine's ``{"pipe": s, "tensor": t}``), leaves whose
+    PartitionSpec names any of those axes are chunked PER mesh
+    coordinate — each model shard's LOCAL flat view splits over the
+    data axis independently, so moments live as
+    ``[axis_size, *present_axis_sizes, chunk]`` globally (sharded over
+    data and every present axis) and the in-shard_map math is
+    unchanged: inside shard_map a leaf's "size" IS its local shard
+    size, and the psum_scatter / all_gather pair runs within the model
+    coordinate. Leaves replicated over a shard axis get a pmean drift
+    guard on their chunk over that axis (their grads are already
+    identical across its shards — e.g. the Megatron f-boundary psum).
 
     Gradient clipping (round 5): ``clip_norm`` applies optax's
     clip_by_global_norm rule to the scattered chunks using the EXACT
-    global norm — one psum over (data, tensor) of per-device squared
-    sums, with replicated leaves' contribution divided by tensor_size
-    so every global element counts exactly once.
+    global norm — one psum over (data, *shard_axes) of per-device
+    squared sums, with each leaf's contribution pre-divided by the
+    product of the shard-axis sizes it is REPLICATED over, so every
+    global element counts exactly once.
     """
 
     def __init__(
@@ -279,8 +368,7 @@ class Zero1Adam:
         axis_size: int,
         seq_axis: str | None = None,
         seq_size: int = 1,
-        tensor_axis: str | None = None,
-        tensor_size: int = 1,
+        shard_axes: dict | None = None,
         clip_norm: float | None = None,
     ):
         self.schedule = schedule
@@ -290,33 +378,36 @@ class Zero1Adam:
         self.axis_size = axis_size
         self.seq_axis = seq_axis
         self.seq_size = seq_size
-        self.tensor_axis = tensor_axis if tensor_size > 1 else None
-        self.tensor_size = tensor_size if tensor_size > 1 else 1
+        self.shard_axes = {
+            a: n for a, n in (shard_axes or {}).items() if n > 1
+        }
+        if clip_norm is not None and clip_norm <= 0:
+            raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
         self.clip_norm = clip_norm
 
     def _chunk(self, size: int) -> int:
         return -(-size // self.axis_size)  # ceil
 
-    def _tp_dim(self, spec) -> int | None:
-        return spec_dim(spec, self.tensor_axis)
+    def _present(self, spec) -> tuple:
+        """The shard axes ``spec`` names, in shard_axes order."""
+        return tuple(
+            a for a in self.shard_axes if spec_dim(spec, a) is not None
+        )
 
     def init(self, params, specs=None):
         """Host-side global moment zeros: ``[axis_size, chunk]`` per
-        replicated leaf, ``[axis_size, tensor_size, chunk]`` per
-        tensor-sharded leaf (``specs`` = the param PartitionSpec tree;
+        replicated leaf, ``[axis_size, *present_sizes, chunk]`` per
+        model-sharded leaf (``specs`` = the param PartitionSpec tree;
         chunk = ceil(LOCAL leaf size / axis_size))."""
         if specs is None:
             specs = _replicated_specs(params)
 
         def leaf(p, spec):
-            if self._tp_dim(spec) is None:
-                return jnp.zeros(
-                    (self.axis_size, self._chunk(p.size)), jnp.float32
-                )
-            local = p.size // self.tensor_size
+            present = self._present(spec)
+            sizes = tuple(self.shard_axes[a] for a in present)
+            local = p.size // math.prod(sizes)
             return jnp.zeros(
-                (self.axis_size, self.tensor_size, self._chunk(local)),
-                jnp.float32,
+                (self.axis_size, *sizes, self._chunk(local)), jnp.float32
             )
 
         moment = lambda: jax.tree.map(leaf, params, specs)
@@ -357,11 +448,11 @@ class Zero1Adam:
         """Inside shard_map: LOCAL (pre-sync) grad leaf -> this device's
         f32 chunk of the data-mean gradient. The psum_scatter IS the
         data reduction (half an allreduce's bytes, pre-sharded); seq
-        replicas average on the chunk; replicated-over-tensor leaves get
-        the tensor drift-guard pmean (their grads are already identical
-        across tensor shards)."""
+        replicas average on the chunk; leaves replicated over a shard
+        axis get that axis's drift-guard pmean (their grads are already
+        identical across its shards)."""
         s = self.axis_size
-        chunk = self._chunk(g.size)  # g.size = LOCAL tensor-shard size
+        chunk = self._chunk(g.size)  # g.size = LOCAL model-shard size
         pad = s * chunk - g.size
         g2d = jnp.pad(g.ravel().astype(jnp.float32), (0, pad)).reshape(
             s, chunk
@@ -371,34 +462,36 @@ class Zero1Adam:
         )
         if self.seq_axis is not None and self.seq_size > 1:
             g_mine = lax.pmean(g_mine, self.seq_axis)
-        if self.tensor_axis is not None and self._tp_dim(spec) is None:
-            g_mine = lax.pmean(g_mine, self.tensor_axis)
+        present = self._present(spec)
+        for a in self.shard_axes:
+            if a not in present:
+                g_mine = lax.pmean(g_mine, a)
         return g_mine
 
     def _clip_chunks(self, chunks, specs):
         """optax.clip_by_global_norm's rule on the scattered mean-grad
-        chunks, with the EXACT global norm: chunks of tensor-sharded
-        leaves partition their elements over (data, tensor) and count
-        once; replicated leaves' chunks repeat per tensor coordinate, so
-        their squared sum is pre-divided by tensor_size. One psum over
-        (data [, tensor]) yields the same norm on every device (seq
-        replicas already hold identical chunks — no seq psum). Padding
-        contributes zeros."""
+        chunks, with the EXACT global norm: chunks of model-sharded
+        leaves partition their elements over (data, *present axes) and
+        count once; chunks replicated over a shard axis repeat per
+        coordinate of it, so their squared sum is pre-divided by that
+        axis's size. One psum over (data, *shard_axes) yields the same
+        norm on every device (seq replicas already hold identical
+        chunks — no seq psum). Padding contributes zeros."""
         if self.clip_norm is None:
             return chunks
-        tp = self.tensor_size
 
         def leaf_sq(g, spec):
-            sq = jnp.sum(g * g)
-            return sq if self._tp_dim(spec) is not None else sq / tp
+            present = self._present(spec)
+            repl = math.prod(
+                n for a, n in self.shard_axes.items() if a not in present
+            )
+            return jnp.sum(g * g) / repl
 
         local = sum(
             jax.tree.leaves(jax.tree.map(leaf_sq, chunks, specs)),
             start=jnp.float32(0.0),
         )
-        axes = (self.axis_name,) + (
-            (self.tensor_axis,) if self.tensor_axis is not None else ()
-        )
+        axes = (self.axis_name, *self.shard_axes)
         g_norm = jnp.sqrt(lax.psum(local, axes))
         trigger = g_norm < self.clip_norm
         scale = self.clip_norm / g_norm
@@ -472,8 +565,21 @@ class FsdpAdam(Zero1Adam):
     the in-shard_map unshard reconstructs the LOCAL tensor shard (so
     ``gather_params`` takes the LOCAL shape tree), and ``unshard_host``
     reassembles the global leaf by concatenating the per-tensor-shard
-    pieces along the sharded dim.
+    pieces along the sharded dim. At most ONE model-shard axis
+    (``Zero1Adam``'s generalized dict supports several for the
+    pipeline engine's moments, but fsdp's host shard/unshard pair is
+    single-axis).
     """
+
+    def _model_axis(self) -> tuple:
+        """(axis_name, size) of the single model-shard axis (None, 1 if
+        none configured)."""
+        if len(self.shard_axes) > 1:
+            raise ValueError(
+                "FsdpAdam supports at most one model-shard axis, got "
+                f"{tuple(self.shard_axes)}"
+            )
+        return next(iter(self.shard_axes.items()), (None, 1))
 
     def shard_params(self, params, specs=None):
         """GLOBAL param tree -> flat chunked shards: ``[axis_size,
@@ -482,6 +588,7 @@ class FsdpAdam(Zero1Adam):
         over the data axis independently)."""
         if specs is None:
             specs = _replicated_specs(params)
+        axis, size = self._model_axis()
 
         def rows(x):
             # flat local view -> zero-padded [axis_size, chunk]
@@ -491,11 +598,11 @@ class FsdpAdam(Zero1Adam):
             ).reshape(self.axis_size, chunk)
 
         def leaf(p, spec):
-            k = self._tp_dim(spec)
+            k = spec_dim(spec, axis)
             if k is None:
                 return rows(p)
             return jnp.stack(
-                [rows(sh) for sh in jnp.split(p, self.tensor_size, axis=k)],
+                [rows(sh) for sh in jnp.split(p, size, axis=k)],
                 axis=1,
             )
 
@@ -517,11 +624,12 @@ class FsdpAdam(Zero1Adam):
 
         if specs is None:
             specs = _replicated_specs(shape_tree)
+        axis, size = self._model_axis()
 
         def leaf(sh, sds, spec):
             flat = np.asarray(jax.device_get(sh))
             dtype = np.asarray([], sds.dtype).dtype
-            k = self._tp_dim(spec)
+            k = spec_dim(spec, axis)
             if k is None:
                 return (
                     flat.reshape(-1)[: math.prod(sds.shape)]
@@ -529,11 +637,11 @@ class FsdpAdam(Zero1Adam):
                     .astype(dtype)
                 )
             local_shape = list(sds.shape)
-            local_shape[k] //= self.tensor_size
+            local_shape[k] //= size
             local_size = math.prod(local_shape)
             parts = [
                 flat[:, t, :].reshape(-1)[:local_size].reshape(local_shape)
-                for t in range(self.tensor_size)
+                for t in range(size)
             ]
             return np.concatenate(parts, axis=k).astype(dtype)
 
@@ -543,12 +651,14 @@ class FsdpAdam(Zero1Adam):
         """FSDP grads arrive pre-scattered (the ``[1, (1,) chunk]``
         cotangents of ``gather_params`` — the all_gather transpose
         already psum_scattered the data-axis SUM): divide into the mean,
-        seq-pmean, tensor drift guard for replicated leaves."""
+        seq-pmean, model-axis drift guard for replicated leaves."""
         g_mine = g.reshape(-1).astype(jnp.float32) / self.axis_size
         if self.seq_axis is not None and self.seq_size > 1:
             g_mine = lax.pmean(g_mine, self.seq_axis)
-        if self.tensor_axis is not None and self._tp_dim(spec) is None:
-            g_mine = lax.pmean(g_mine, self.tensor_axis)
+        present = self._present(spec)
+        for a in self.shard_axes:
+            if a not in present:
+                g_mine = lax.pmean(g_mine, a)
         return g_mine
 
     def apply(self, param_shards, state, grad_chunks, specs=None):

@@ -157,8 +157,10 @@ class LMConfig:
     # Composes with tensor_parallel (local tensor shards chunk per
     # (data, tensor) coordinate) and grad_clip_norm (exact global norm
     # via one psum of per-chunk squared sums). Requires
-    # optimizer="adamw" and no expert parallelism; checkpoints carry
-    # the chunk layout, so resume needs the same data_parallel.
+    # optimizer="adamw" and no expert parallelism. Checkpoint resume is
+    # mesh-ELASTIC over data_parallel (round 5): flat chunks re-chunk
+    # on restore ([dp_old, c_old] -> [dp_new, c_new], host-side);
+    # tensor_parallel is layout-pinned and must match the save.
     zero1: bool = False
 
     # ZeRO-3/FSDP (parallel/zero.py::FsdpAdam): params AND both AdamW
@@ -421,10 +423,11 @@ class LMTrainer:
                 weight_decay=cfg.weight_decay, axis_name=DATA_AXIS,
                 axis_size=self.data_size, seq_axis=SEQ_AXIS,
                 seq_size=self.seq_size,
-                tensor_axis=(
-                    TENSOR_AXIS if TENSOR_AXIS in self.mesh.shape else None
+                shard_axes=(
+                    {TENSOR_AXIS: self.tensor_size}
+                    if TENSOR_AXIS in self.mesh.shape
+                    else None
                 ),
-                tensor_size=self.tensor_size,
                 clip_norm=cfg.grad_clip_norm,
             )
             # The original (tensor-aware) specs drive the chunk layout;
@@ -448,6 +451,25 @@ class LMTrainer:
                 "nu": moment_specs,
                 "count": P(),
             }
+            # Mesh-elastic resume: re-chunk flat [dp_old(, tp), chunk]
+            # checkpoint state to the current data_parallel's layout
+            # (parallel/zero.py::make_elastic_adapt; moments always,
+            # chunked params too under fsdp; tensor coordinates are
+            # layout-pinned).
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+                chunk_local_sizes,
+                make_elastic_adapt,
+            )
+
+            self._zero_elastic_adapt = make_elastic_adapt(
+                chunk_local_sizes(
+                    param_shapes,
+                    self._orig_param_specs,
+                    {TENSOR_AXIS: self.tensor_size},
+                ),
+                prefixes=("opt_state/mu/", "opt_state/nu/")
+                + (("params/",) if cfg.fsdp else ()),
+            )
             if cfg.fsdp:
                 # Params live as flat chunked shards too: the original
                 # full shapes/dtypes are the unshard template, and the
@@ -960,7 +982,12 @@ class LMTrainer:
 
             ckpt = Checkpointer(cfg.checkpoint_dir)
             restored = ckpt.restore_latest(
-                LMState(jnp.zeros((), jnp.int32), params, opt_state)
+                LMState(jnp.zeros((), jnp.int32), params, opt_state),
+                adapt=(
+                    self._zero_elastic_adapt
+                    if self._zero1_opt is not None
+                    else None
+                ),
             )
             if restored is not None:
                 start_step = int(jax.device_get(restored.step))

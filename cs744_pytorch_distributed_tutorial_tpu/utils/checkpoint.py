@@ -62,11 +62,17 @@ class Checkpointer:
         if wait:
             self.manager.wait_until_finished()
 
-    def restore_latest(self, template: Any) -> Any | None:
+    def restore_latest(self, template: Any, adapt=None) -> Any | None:
         """Restore the newest checkpoint into ``template``'s structure and
         shardings; None if the directory has no checkpoints. Leaves whose
         SAVED leading axis differs from the template's (a different world
-        size) are resized — slice down, or tile cyclically up."""
+        size) are resized — slice down, or tile cyclically up.
+
+        ``adapt`` customizes that resizing per leaf (the ZeRO engines'
+        flat-chunk state re-chunks rather than slices): called as
+        ``adapt(path_key, saved_host_array, template_leaf)`` for every
+        shape-mismatched fully-addressable leaf, it returns the adapted
+        host array or None to fall through to the default slice/tile."""
         self.manager.wait_until_finished()  # in-flight saves land first
         step = self.manager.latest_step()
         if step is None:
@@ -124,7 +130,7 @@ class Checkpointer:
             step, args=self._ocp.args.StandardRestore(target)
         )
 
-        def adapt(saved, like):
+        def adapt_leaf(path, saved, like):
             if isinstance(saved, jax.Array) and not saved.is_fully_addressable:
                 if saved.shape == like.shape:
                     # Same-shape leaf already living on a process-spanning
@@ -140,6 +146,10 @@ class Checkpointer:
             saved = np.asarray(jax.device_get(saved))
             if saved.shape == like.shape:
                 return saved
+            if adapt is not None:
+                out = adapt(_path_key(path), saved, like)
+                if out is not None:
+                    return out
             if saved.shape[1:] != like.shape[1:] or saved.ndim == 0:
                 raise ValueError(
                     f"cannot adapt checkpoint leaf of shape {saved.shape} to "
@@ -152,7 +162,7 @@ class Checkpointer:
             reps = -(-n // saved.shape[0])
             return np.tile(saved, (reps,) + (1,) * (saved.ndim - 1))[:n]
 
-        return jax.tree.map(adapt, raw, template)
+        return jax.tree_util.tree_map_with_path(adapt_leaf, raw, template)
 
     def close(self) -> None:
         self.manager.wait_until_finished()

@@ -198,6 +198,23 @@ def test_lm_cli_speculative_decode(capsys):
         ])
 
 
+def test_lm_cli_pipeline_zero1_and_clip(capsys):
+    # round 5: the pipeline engine accepts --zero1 (data-sharded AdamW
+    # moments) and --grad-clip-norm (spec-aware global norm) instead of
+    # rejecting them.
+    rc = main([
+        "--pipeline-parallel", "2", "--data-parallel", "2",
+        "--num-layers", "2", "--num-heads", "2", "--d-model", "32",
+        "--d-ff", "64", "--max-seq-len", "32", "--seq-len", "16",
+        "--global-batch-size", "8", "--num-seqs", "16", "--steps", "2",
+        "--zero1", "--grad-clip-norm", "0.5", "--log-every", "1",
+        "--json",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["engine"] == "pipeline" and summary["finite"]
+
+
 def test_lm_cli_speculative_decode_with_fsdp(capsys):
     # --fsdp leaves both target and draft params in chunked [dp, chunk]
     # layout; the decode path must unshard BOTH (ADVICE r4: the draft's

@@ -216,6 +216,83 @@ def test_zero_full_matrix_dp_sp_tp():
     np.testing.assert_allclose(base, f, rtol=2e-5)
 
 
+@pytest.mark.parametrize("dp_save,dp_resume", [(4, 2), (2, 4)])
+def test_zero1_elastic_resume(tmp_path, dp_save, dp_resume):
+    """Mesh-elastic ZeRO resume (VERDICT r4 #4): save at dp_save,
+    resume at dp_resume — the restore re-chunks [dp_old, c_old] flat
+    state to [dp_new, c_new] and the trajectory matches the
+    UNINTERRUPTED dp_save run at rtol 1e-6 (chunking is layout, not
+    math)."""
+    tokens = synthetic_tokens(8, 16, 64, seed=0)
+    mesh_a = make_mesh({"data": dp_save, "seq": 1},
+                       devices=jax.devices()[:dp_save])
+    mesh_b = make_mesh({"data": dp_resume, "seq": 1},
+                       devices=jax.devices()[:dp_resume])
+    ckdir = str(tmp_path / "ck")
+    tr = LMTrainer(
+        _cfg(data_parallel=dp_save, zero1=True, checkpoint_dir=ckdir,
+             checkpoint_every=2),
+        mesh=mesh_a,
+    )
+    _, _, head = tr.fit(tokens, steps=4)
+    tr2 = LMTrainer(
+        _cfg(data_parallel=dp_resume, zero1=True, checkpoint_dir=ckdir,
+             checkpoint_every=2),
+        mesh=mesh_b,
+    )
+    _, _, tail = tr2.fit(tokens, steps=6)
+    assert len(tail) == 2, tail
+    oracle = LMTrainer(_cfg(data_parallel=dp_save, zero1=True), mesh=mesh_a)
+    _, _, full = oracle.fit(tokens, steps=6)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-6)
+
+
+def test_elastic_resume_rejects_model_shape_change(tmp_path):
+    """The elastic re-chunk only bends over data_parallel: resuming a
+    zero1 checkpoint with a CHANGED model shape (stale flat chunks)
+    must fail loudly, not silently slice old state."""
+    tokens = synthetic_tokens(8, 16, 64, seed=0)
+    mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
+    ckdir = str(tmp_path / "ck")
+    tr = LMTrainer(
+        _cfg(data_parallel=2, zero1=True, checkpoint_dir=ckdir,
+             checkpoint_every=2),
+        mesh=mesh,
+    )
+    tr.fit(tokens, steps=2)
+    bigger = LMTrainer(
+        _cfg(data_parallel=2, zero1=True, d_ff=128,
+             checkpoint_dir=ckdir),
+        mesh=mesh,
+    )
+    with pytest.raises(ValueError, match="model shape|cannot adapt"):
+        bigger.fit(tokens, steps=4)
+
+
+def test_fsdp_elastic_resume_with_tp(tmp_path):
+    """FSDP chunked PARAMS re-chunk too, and the tensor coordinate
+    (middle axis) rides along untouched: save on dp2 x tp2, resume on
+    dp4 x tp2 (8 devices) — trajectory matches the uninterrupted run."""
+    tokens = synthetic_tokens(8, 16, 64, seed=0)
+    mesh_a = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                       devices=jax.devices()[:4])
+    mesh_b = make_mesh({"data": 4, "seq": 1, "tensor": 2},
+                       devices=jax.devices()[:8])
+    ckdir = str(tmp_path / "ck")
+    kw = dict(tensor_parallel=2, fsdp=True, checkpoint_dir=ckdir,
+              checkpoint_every=2)
+    tr = LMTrainer(_cfg(data_parallel=2, **kw), mesh=mesh_a)
+    _, _, head = tr.fit(tokens, steps=4)
+    tr2 = LMTrainer(_cfg(data_parallel=4, **kw), mesh=mesh_b)
+    _, _, tail = tr2.fit(tokens, steps=6)
+    assert len(tail) == 2, tail
+    oracle = LMTrainer(
+        _cfg(data_parallel=2, tensor_parallel=2, fsdp=True), mesh=mesh_a
+    )
+    _, _, full = oracle.fit(tokens, steps=6)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-6)
+
+
 def test_sharded_clip_matches_single_device_optax_clip():
     """The replicated-optimizer path under TP now clips via the
     spec-aware transform (train/state.py::clip_by_global_norm_sharded):

@@ -1,0 +1,197 @@
+"""ZeRO-1 on the PIPELINE engine (round 5 — the last missing family
+pair, VERDICT r4 #3): optimizer state sharded over the DATA axis while
+the pipe axis shards blocks (and the tensor axis their kernels).
+
+The load-bearing property is the LM engine's: chunk-wise AdamW over
+data-sharded moments — here chunked per (pipe[, tensor]) coordinate via
+``Zero1Adam``'s generalized ``shard_axes`` — IS the replicated optimizer
+up to float reassociation, so the trajectory must match while per-device
+optimizer memory drops by the data-parallel factor on top of the
+pipe/tensor sharding. The reference has no optimizer sharding at all
+(full SGD replica per rank, ``master/part2a/part2a.py:127-128``).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+    DATA_AXIS,
+    PIPE_AXIS,
+    PipelineLMConfig,
+    PipelineLMTrainer,
+)
+
+TENSOR_AXIS = "tensor"
+
+
+def _cfg(**kw) -> PipelineLMConfig:
+    base = dict(
+        vocab_size=64,
+        num_layers=4,
+        num_heads=4,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=64,
+        seq_len=16,
+        global_batch_size=8,
+        num_microbatches=2,
+        learning_rate=3e-3,
+        lr_schedule="warmup_cosine",
+        warmup_steps=2,
+        total_steps=8,
+    )
+    base.update(kw)
+    return PipelineLMConfig(**base)
+
+
+def _mesh(data, pipe, tensor=1):
+    axes = {DATA_AXIS: data, PIPE_AXIS: pipe}
+    if tensor > 1:
+        axes[TENSOR_AXIS] = tensor
+    return make_mesh(axes, devices=jax.devices()[: data * pipe * tensor])
+
+
+def _tokens(cfg, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(
+        0, cfg.vocab_size, (cfg.global_batch_size, cfg.seq_len + 1),
+        dtype=np.int64,
+    )
+
+
+def _run(cfg, mesh, steps=6):
+    tr = PipelineLMTrainer(cfg, mesh=mesh)
+    params, opt = tr.init()
+    tokens = _tokens(cfg)
+    x, y = tr.shard_batch(tokens)
+    losses = []
+    for s in range(steps):
+        params, opt, m = tr.train_step(params, opt, x, y, s)
+        losses.append(float(m["loss"]))
+    jax.block_until_ready((params, opt))
+    return tr, params, opt, losses
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_zero1_trajectory_matches_replicated(schedule):
+    """dp2 x pp2: the data-sharded-moment trajectory IS the replicated
+    adamw trajectory, on both the AD-derived and hand-scheduled
+    backward."""
+    mesh = _mesh(2, 2)
+    kw = dict(data_parallel=2, pipeline_parallel=2, schedule=schedule)
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+
+
+def test_pipeline_zero1_with_tensor_and_clip():
+    """dp2 x pp2 x tp2 with grad clipping: block kernels chunk per
+    (pipe, tensor) coordinate, the clip's psum spans (data, pipe,
+    tensor) with replication multiplicities — trajectory still matches
+    the replicated optimizer (whose clip is the spec-aware sharded
+    transform)."""
+    mesh = _mesh(2, 2, 2)
+    kw = dict(
+        data_parallel=2, pipeline_parallel=2, tensor_parallel=2,
+        grad_clip_norm=0.05,
+    )
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+    # The clip engages: the trajectory differs from the unclipped one.
+    _, _, _, unclipped = _run(
+        _cfg(data_parallel=2, pipeline_parallel=2, tensor_parallel=2,
+             zero1=True),
+        mesh,
+    )
+    assert not np.allclose(z1[1:], unclipped[1:], rtol=1e-6)
+
+
+def test_pipeline_clip_is_pipe_count_invariant():
+    """The sharded clip's norm is exact for any pipe size: pp2 and pp4
+    trajectories with clipping match on the same global batch (block
+    grads are per-stage locals — a local-norm clip would diverge
+    between the two layouts)."""
+    kw = dict(grad_clip_norm=0.05, num_layers=4)
+    _, _, _, pp2 = _run(_cfg(pipeline_parallel=2, **kw), _mesh(1, 2))
+    _, _, _, pp4 = _run(_cfg(pipeline_parallel=4, **kw), _mesh(1, 4))
+    np.testing.assert_allclose(pp2, pp4, rtol=1e-4)
+
+
+def test_pipeline_zero1_moment_layout():
+    """Structure of the memory claim: block moments are [dp, S(, T),
+    chunk] sharded over (data, pipe[, tensor]); replicated leaves'
+    moments are [dp, chunk] over data."""
+    mesh = _mesh(2, 2, 2)
+    tr, params, opt, _ = _run(
+        _cfg(data_parallel=2, pipeline_parallel=2, tensor_parallel=2,
+             zero1=True),
+        mesh, steps=1,
+    )
+    mu = opt["mu"]
+    q = mu["blocks"]["attn"]["q"]["kernel"]
+    assert q.ndim == 4 and q.shape[:3] == (2, 2, 2)
+    assert tuple(q.sharding.spec)[:3] == ("data", "pipe", "tensor")
+    # ln kernels inside blocks are pipe-sharded but tensor-replicated.
+    ln = mu["blocks"]["ln1"]["scale"]
+    assert ln.ndim == 3 and ln.shape[:2] == (2, 2)
+    assert tuple(ln.sharding.spec)[:2] == ("data", "pipe")
+    emb = mu["embed"]
+    assert emb.ndim == 2 and emb.shape[0] == 2
+    assert tuple(emb.sharding.spec)[:1] == ("data",)
+    assert int(opt["count"]) == 1
+
+
+def test_pipeline_zero1_resume_and_elastic(tmp_path):
+    """Orbax resume oracle (VERDICT r4 #3's done-criterion) plus the
+    mesh-elastic re-chunk: save at dp2 x pp2, resume at dp1 x pp2 —
+    trajectory matches the uninterrupted dp2 run at rtol 1e-6."""
+    cfg = _cfg(
+        data_parallel=2, pipeline_parallel=2, zero1=True,
+        checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2,
+    )
+    tokens = _tokens(cfg)
+    tr = PipelineLMTrainer(cfg, mesh=_mesh(2, 2))
+    _, _, head = tr.fit(tokens, steps=4)
+    # Same-mesh resume.
+    tr2 = PipelineLMTrainer(cfg, mesh=_mesh(2, 2))
+    _, _, tail = tr2.fit(tokens, steps=6)
+    assert len(tail) == 2, tail
+    oracle = PipelineLMTrainer(
+        cfg.replace(checkpoint_dir=None), mesh=_mesh(2, 2)
+    )
+    _, _, full = oracle.fit(tokens, steps=6)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-6)
+
+    # Elastic: fresh run saves at dp2, resumes at dp1 (re-chunked).
+    cfg_e = cfg.replace(checkpoint_dir=str(tmp_path / "ck_elastic"))
+    tr3 = PipelineLMTrainer(cfg_e, mesh=_mesh(2, 2))
+    _, _, head_e = tr3.fit(tokens, steps=4)
+    cfg_1 = cfg_e.replace(data_parallel=1)
+    tr4 = PipelineLMTrainer(cfg_1, mesh=_mesh(1, 2))
+    _, _, tail_e = tr4.fit(tokens, steps=6)
+    assert len(tail_e) == 2, tail_e
+    np.testing.assert_allclose(head_e + tail_e, full, rtol=1e-6)
+
+
+def test_pipeline_zero1_rejections():
+    with pytest.raises(ValueError, match="clip_norm must be > 0"):
+        PipelineLMTrainer(
+            _cfg(data_parallel=2, pipeline_parallel=2, zero1=True,
+                 grad_clip_norm=-1.0),
+            mesh=_mesh(2, 2),
+        )
+    with pytest.raises(ValueError, match="adamw"):
+        PipelineLMTrainer(
+            _cfg(data_parallel=2, pipeline_parallel=2, zero1=True,
+                 optimizer="sgd"),
+            mesh=_mesh(2, 2),
+        )
+    with pytest.raises(ValueError, match="expert"):
+        PipelineLMTrainer(
+            _cfg(data_parallel=2, pipeline_parallel=2, zero1=True,
+                 moe_experts=2, moe_expert_parallel=True),
+            mesh=_mesh(2, 2),
+        )
