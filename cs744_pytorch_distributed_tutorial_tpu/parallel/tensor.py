@@ -34,8 +34,10 @@ from jax import lax
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
-def copy_to_tp_region(x: jax.Array, axis_name: str) -> jax.Array:
-    """Identity forward; psum over ``axis_name`` on the backward pass."""
+def copy_to_tp_region(x: jax.Array, axis_name) -> jax.Array:
+    """Identity forward; psum over ``axis_name`` on the backward pass.
+    ``axis_name`` is a mesh axis name or a TUPLE of them (a jointly
+    sharded region, e.g. the pipe x tensor 1F1B tail)."""
     return x
 
 
@@ -51,8 +53,9 @@ copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_from_tp_region(x: jax.Array, axis_name: str) -> jax.Array:
-    """psum over ``axis_name`` forward; identity on the backward pass."""
+def reduce_from_tp_region(x: jax.Array, axis_name) -> jax.Array:
+    """psum over ``axis_name`` forward; identity on the backward pass.
+    ``axis_name`` is a mesh axis name or a TUPLE of them."""
     return lax.psum(x, axis_name)
 
 

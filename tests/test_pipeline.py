@@ -1021,6 +1021,77 @@ def test_1f1b_distributed_tail_head_width():
     assert sliced, "no V/S-width head dot found — tail not sharded?"
 
 
+def test_1f1b_distributed_tail_composes_with_tensor_axis():
+    """Round 5 (VERDICT r4 #5): with a tensor axis the per-stage tail
+    width is V/(S*T), not V/T — the jaxpr must contain head matmuls at
+    the joint width and none at the per-tensor-shard width, and the
+    dp2 x pp2 x tp2 trajectory must match the GPipe schedule (whose
+    tail is computed once, full, outside the schedule)."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
+        TENSOR_AXIS,
+    )
+
+    # Width pin on pipe=2 x tensor=2 (4 devices): vocab 192 -> V/T = 96
+    # per tensor shard, V/(S*T) = 48 per (stage, shard).
+    d_model, vocab, pipe, tensor = 32, 192, 2, 2
+    cfg = PipelineLMConfig(
+        vocab_size=vocab, num_layers=4, num_heads=4, d_model=d_model,
+        d_ff=64, max_seq_len=64, data_parallel=1, pipeline_parallel=pipe,
+        tensor_parallel=tensor, num_microbatches=2,
+        global_batch_size=4, seq_len=16, schedule="1f1b",
+    )
+    mesh = make_mesh(
+        {DATA_AXIS: 1, PIPE_AXIS: pipe, TENSOR_AXIS: tensor},
+        devices=jax.devices()[: pipe * tensor],
+    )
+    tr = PipelineLMTrainer(cfg, mesh=mesh)
+    assert tr._dist_tail
+    params, opt = tr.init()
+    x, y = tr.shard_batch(tokens_for(cfg))
+    jaxpr = jax.make_jaxpr(
+        lambda p, o, a, b: tr.jitted_train_step(p, o, a, b, jnp.int32(0))
+    )(params, opt, x, y)
+    shapes = _dot_operand_shapes(jaxpr.jaxpr)
+    per_shard = [
+        s for s in shapes
+        if (d_model, vocab // tensor) in s or (vocab // tensor, d_model) in s
+    ]
+    joint = [s for s in shapes if (d_model, vocab // (pipe * tensor)) in s]
+    assert not per_shard, f"V/T-width head dot survived: {per_shard}"
+    assert joint, "no V/(S*T)-width head dot found — tail not composed?"
+
+    # Trajectory parity vs GPipe on dp2 x pp2 x tp2 (8 devices).
+    results = {}
+    for schedule in ("gpipe", "1f1b"):
+        cfg8 = PipelineLMConfig(
+            vocab_size=64, num_layers=4, num_heads=4, d_model=32, d_ff=64,
+            max_seq_len=64, data_parallel=2, pipeline_parallel=2,
+            tensor_parallel=2, num_microbatches=2,
+            global_batch_size=8, seq_len=16, schedule=schedule,
+        )
+        mesh8 = make_mesh(
+            {DATA_AXIS: 2, PIPE_AXIS: 2, TENSOR_AXIS: 2},
+            devices=jax.devices()[:8],
+        )
+        tr8 = PipelineLMTrainer(cfg8, mesh=mesh8)
+        assert tr8._dist_tail == (schedule == "1f1b")
+        p8, o8 = tr8.init(0)
+        x8, y8 = tr8.shard_batch(tokens_for(cfg8))
+        losses = []
+        for s_ in range(3):
+            p8, o8, m8 = tr8.train_step(p8, o8, x8, y8, s_)
+            losses.append(float(m8["loss"]))
+        results[schedule] = (losses, jax.device_get(p8))
+    np.testing.assert_allclose(
+        results["1f1b"][0], results["gpipe"][0], rtol=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-6),
+        results["1f1b"][1],
+        results["gpipe"][1],
+    )
+
+
 def test_1f1b_distributed_tail_fallback_when_indivisible():
     """vocab % pipe != 0 falls back to the replicated tail (correct,
     just unsharded) rather than refusing the config."""

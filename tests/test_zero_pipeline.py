@@ -86,16 +86,18 @@ def test_pipeline_zero1_trajectory_matches_replicated(schedule):
     np.testing.assert_allclose(base, z1, rtol=2e-5)
 
 
-def test_pipeline_zero1_with_tensor_and_clip():
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_zero1_with_tensor_and_clip(schedule):
     """dp2 x pp2 x tp2 with grad clipping: block kernels chunk per
     (pipe, tensor) coordinate, the clip's psum spans (data, pipe,
     tensor) with replication multiplicities — trajectory still matches
     the replicated optimizer (whose clip is the spec-aware sharded
-    transform)."""
+    transform). The 1f1b case additionally runs the COMPOSED
+    distributed tail (per-stage head width V/(S*T)) under zero1."""
     mesh = _mesh(2, 2, 2)
     kw = dict(
         data_parallel=2, pipeline_parallel=2, tensor_parallel=2,
-        grad_clip_norm=0.05,
+        grad_clip_norm=0.05, schedule=schedule,
     )
     _, _, _, base = _run(_cfg(**kw), mesh)
     _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
@@ -103,7 +105,7 @@ def test_pipeline_zero1_with_tensor_and_clip():
     # The clip engages: the trajectory differs from the unclipped one.
     _, _, _, unclipped = _run(
         _cfg(data_parallel=2, pipeline_parallel=2, tensor_parallel=2,
-             zero1=True),
+             zero1=True, schedule=schedule),
         mesh,
     )
     assert not np.allclose(z1[1:], unclipped[1:], rtol=1e-6)
