@@ -80,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-groups", type=int, default=1,
                    help="token groups for MoE routing/capacity (GShard "
                         "dispatch-cost lever; 0 = auto ~1024 tokens/group)")
+    p.add_argument("--moe-dispatch", choices=("einsum", "scatter"),
+                   default="scatter",
+                   help="token movement: GShard one-hot einsums, or "
+                        "scatter-add/gather (round 5 — same routing and "
+                        "drop semantics)")
     p.add_argument("--moe-expert-parallel", action="store_true")
     # mesh
     p.add_argument("--data-parallel", type=int, default=1)
@@ -313,6 +318,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_groups=args.moe_groups,
+        moe_dispatch=args.moe_dispatch,
         moe_expert_parallel=args.moe_expert_parallel,
         data_parallel=args.data_parallel,
         pipeline_parallel=args.pipeline_parallel,
@@ -458,6 +464,7 @@ def main(argv: list[str] | None = None) -> int:
         moe_experts=args.moe_experts,
         moe_top_k=args.moe_top_k,
         moe_groups=args.moe_groups,
+        moe_dispatch=args.moe_dispatch,
         moe_expert_parallel=args.moe_expert_parallel,
         data_parallel=args.data_parallel,
         seq_parallel=args.seq_parallel,

@@ -49,6 +49,7 @@ from cs744_pytorch_distributed_tutorial_tpu.models.vit import (
     ViT,
     vit_small,
     vit_tiny,
+    vit_wide_p8,
 )
 
 
@@ -89,6 +90,7 @@ MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {
     "resnet50": resnet50,
     "vit_tiny": vit_tiny,
     "vit_small": vit_small,
+    "vit_wide_p8": vit_wide_p8,
     "tiny_cnn": tiny_cnn,
 }
 # TransformerLM is deliberately NOT in MODEL_REGISTRY: the registry's
@@ -121,6 +123,7 @@ __all__ = [
     "ViT",
     "vit_small",
     "vit_tiny",
+    "vit_wide_p8",
     "VGG",
     "VGG_CFGS",
     "resnet18",

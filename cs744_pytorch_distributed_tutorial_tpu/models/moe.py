@@ -9,9 +9,13 @@ Design, TPU-first:
 - **Static shapes everywhere.** Token->expert routing is data-dependent,
   which XLA cannot tile; the standard TPU answer is the capacity-slot
   formulation (Switch Transformer / GShard): each expert has a fixed
-  number of slots ``C``, routing materializes as dense one-hot
-  ``dispatch``/``combine`` tensors, and the actual token movement is two
-  einsums — MXU work, not scatter/gather.
+  number of slots ``C`` and dropped tokens ride the residual. Token
+  MOVEMENT into/out of the slots has two implementations
+  (``dispatch_impl``): the GShard one-hot einsums, and the round-5
+  scatter-add/gather default — measured on a v5e, scatter at one
+  global group beats the einsum path's best grouped setting while
+  keeping the ungrouped near-zero drop rate (einsum at the same drop
+  rate is 2.9x slower; benchmarks/bench_vit_moe.py).
 - **Expert parallelism is one ``lax.all_to_all`` pair.** With experts
   sharded over a mesh axis (here: the ``data`` axis — the standard
   "EP over DP" layout), each device dispatches its local tokens into
@@ -73,6 +77,18 @@ class MoEFFN(nn.Module):
     # becomes per-group — num_groups is part of the routing semantics,
     # not just a performance knob. 0 = auto: target ~1024 tokens/group.
     num_groups: int = 1
+    # Token movement implementation (round 5, VERDICT r4 #6 — the
+    # 1.41x residual routed-vs-dense tax lived in the dispatch/combine
+    # one-hot einsums). Routing, priority, capacity and drop semantics
+    # are IDENTICAL across the two (the same cumsum-derived slot
+    # positions drive both); only how tokens reach their slots differs:
+    # - "einsum": dense [G,N,E,C] dispatch/combine one-hot contractions
+    #   (MXU work, O(N*E*C*D) per group — the GShard formulation);
+    # - "scatter": scatter-add tokens into [G,E,C,D] slot buffers and
+    #   gather+weight the outputs back (O(N*K*D) per group — the
+    #   sort-free equivalent of sort-based/ragged dispatch; AD
+    #   transposes scatter<->gather, so gradients route for free).
+    dispatch_impl: str = "scatter"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -146,19 +162,48 @@ class MoEFFN(nn.Module):
         # not. Callers that pass mutable=["metrics"] receive it; others
         # (the pipeline stage fn) silently drop it, by flax's contract.
         self.sow("metrics", "moe_drop", 1.0 - keep.mean())
-        routed = onehot * keep[..., None]  # [G, N, K, E]
-        slot = jax.nn.one_hot(
-            pos_k.astype(jnp.int32), capacity, dtype=jnp.float32
-        )  # [G, N, K, C]
-        dispatch = jnp.einsum("gnke,gnkc->gnec", routed, slot)
-        combine = jnp.einsum("gnk,gnke,gnkc->gnec", topk_gate, routed, slot)
+        if self.dispatch_impl not in ("einsum", "scatter"):
+            raise ValueError(
+                f"unknown dispatch_impl {self.dispatch_impl!r}; "
+                "choose 'einsum' or 'scatter'"
+            )
+        scatter = self.dispatch_impl == "scatter"
+        if scatter:
+            # ---- scatter tokens into expert slot blocks -----------------
+            # Each kept (token, k) pair owns exactly one slot (the
+            # cumsum positions are unique per expert), so the
+            # scatter-add never accumulates and is order-independent;
+            # dropped pairs write to the out-of-bounds slot C and are
+            # discarded by mode="drop".
+            g_ar = jnp.arange(g)[:, None, None]
+            pos_i = pos_k.astype(jnp.int32)
+            slot_pos = jnp.where(keep > 0, pos_i, capacity)
+            buf = jnp.zeros((g, e, capacity, d), self.dtype)
+            buf = buf.at[g_ar, topk_idx, slot_pos].add(
+                jnp.broadcast_to(
+                    tokens.astype(self.dtype)[:, :, None, :], (g, n, k, d)
+                ),
+                mode="drop",
+            )
+            expert_in = buf.transpose(1, 0, 2, 3).reshape(
+                e, g * capacity, d
+            )  # [E, G*C, D]
+        else:
+            routed = onehot * keep[..., None]  # [G, N, K, E]
+            slot = jax.nn.one_hot(
+                pos_k.astype(jnp.int32), capacity, dtype=jnp.float32
+            )  # [G, N, K, C]
+            dispatch = jnp.einsum("gnke,gnkc->gnec", routed, slot)
+            combine = jnp.einsum(
+                "gnk,gnke,gnkc->gnec", topk_gate, routed, slot
+            )
 
-        # ---- gather tokens into expert slot blocks (MXU einsum) ---------
-        expert_in = jnp.einsum(
-            "gnec,gnd->egcd",
-            dispatch.astype(self.dtype),
-            tokens.astype(self.dtype),
-        ).reshape(e, g * capacity, d)  # [E, G*C, D]
+            # ---- gather tokens into expert slot blocks (MXU einsum) -----
+            expert_in = jnp.einsum(
+                "gnec,gnd->egcd",
+                dispatch.astype(self.dtype),
+                tokens.astype(self.dtype),
+            ).reshape(e, g * capacity, d)  # [E, G*C, D]
 
         if ep:
             # Re-shard experts -> tokens: every device ends up with the
@@ -189,7 +234,21 @@ class MoEFFN(nn.Module):
 
         # ---- scatter back + weight by gate ------------------------------
         out = out.reshape(e, g, capacity, d)
-        y = jnp.einsum("gnec,egcd->gnd", combine.astype(self.dtype), out)
+        if scatter:
+            # Gather each (token, k) pair's slot output and weight by
+            # its (kept) gate — O(N*K*D); the gather's AD transpose is
+            # the scatter-add that routes d out.
+            out_g = out.transpose(1, 0, 2, 3)  # [G, E, C, D]
+            g_ar = jnp.arange(g)[:, None, None]
+            picked = out_g[
+                g_ar, topk_idx, jnp.clip(pos_i, 0, capacity - 1)
+            ]  # [G, N, K, D]
+            w = (topk_gate * keep).astype(self.dtype)
+            y = (picked * w[..., None]).sum(axis=2)
+        else:
+            y = jnp.einsum(
+                "gnec,egcd->gnd", combine.astype(self.dtype), out
+            )
         return y.reshape(b, t, d)
 
 

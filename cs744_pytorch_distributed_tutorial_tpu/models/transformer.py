@@ -437,6 +437,7 @@ class Block(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_num_groups: int = 1
+    moe_dispatch: str = "scatter"  # token movement: einsum | scatter
     expert_axis: str | None = None
     expert_axis_size: int = 1
     max_decode_len: int | None = None
@@ -538,6 +539,7 @@ class Block(nn.Module):
                 top_k=self.moe_top_k,
                 capacity_factor=self.moe_capacity_factor,
                 num_groups=self.moe_num_groups,
+                dispatch_impl=self.moe_dispatch,
                 dtype=self.dtype,
                 expert_axis=self.expert_axis,
                 expert_axis_size=self.expert_axis_size,
@@ -601,6 +603,7 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_num_groups: int = 1
+    moe_dispatch: str = "scatter"  # token movement: einsum | scatter
     expert_axis: str | None = None
     expert_axis_size: int = 1
     # Rematerialization: recompute each block's activations during the
@@ -724,6 +727,7 @@ class TransformerLM(nn.Module):
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,
             moe_num_groups=self.moe_num_groups,
+            moe_dispatch=self.moe_dispatch,
             expert_axis=self.expert_axis,
             expert_axis_size=self.expert_axis_size,
             max_decode_len=self.max_seq_len,

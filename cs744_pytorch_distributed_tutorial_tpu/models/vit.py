@@ -103,3 +103,19 @@ def vit_small(**kw: Any) -> ViT:
     kw.setdefault("num_heads", 6)
     kw.setdefault("d_ff", 1536)
     return ViT(**kw)
+
+
+def vit_wide_p8(**kw: Any) -> ViT:
+    """ViT/8 for 32x32 inputs — the MXU geometry lever (round 5):
+    patch 8 gives 4x fewer tokens (17 incl. cls) with 4x the pixels
+    each, and the width doubles to 384 at 3 heads so head_dim is 128 —
+    exactly one MXU tile (vit_tiny's d64 heads fill half a tile).
+    Per-sample FLOPs match vit_tiny within ~1% (4x fewer tokens x 4x
+    the d^2 terms), so MFU differences between the two ARE the
+    geometry, not model size."""
+    kw.setdefault("patch_size", 8)
+    kw.setdefault("d_model", 384)
+    kw.setdefault("num_layers", 6)
+    kw.setdefault("num_heads", 3)
+    kw.setdefault("d_ff", 1536)
+    return ViT(**kw)

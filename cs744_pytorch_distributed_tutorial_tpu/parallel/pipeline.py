@@ -656,6 +656,15 @@ def _sharded_ce(
     s = reduce_from_tp_region(e_sum, axis_name)
     # This shard's slice of the target logit: global id -> local column.
     if shard_offset is None:
+        if isinstance(axis_name, (tuple, list)):
+            # The linearized product-region index does NOT describe the
+            # joint vocab layout (e.g. the dist tail's pipe-slice-
+            # within-tensor-shard) — a silent default would score
+            # targets against the wrong logit columns.
+            raise ValueError(
+                "joint-axis _sharded_ce needs an explicit shard_offset "
+                "(the global vocab id of local column 0)"
+            )
         shard_offset = lax.axis_index(axis_name) * vloc
     local_t = targets - shard_offset
     in_range = jnp.logical_and(local_t >= 0, local_t < vloc)
@@ -824,6 +833,7 @@ class PipelineLMConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_groups: int = 1
+    moe_dispatch: str = "scatter"  # token movement: einsum | scatter
     moe_expert_parallel: bool = False
 
     data_parallel: int = 1
@@ -1106,6 +1116,7 @@ class PipelineLMTrainer:
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_num_groups=cfg.moe_groups,
+            moe_dispatch=cfg.moe_dispatch,
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             rope=cfg.use_rope,

@@ -119,6 +119,11 @@ class LMConfig:
     # GShard dispatch-cost lever; 0 = auto ~1024 tokens/group, 1 = one
     # global group). Part of routing semantics: capacity is per group.
     moe_groups: int = 1
+    # Token movement (models/moe.py::MoEFFN.dispatch_impl): "einsum"
+    # (GShard one-hot contractions) or "scatter" (scatter-add/gather —
+    # round 5, targeting the measured dispatch tax). Routing and drop
+    # semantics are identical; trajectories match to float tolerance.
+    moe_dispatch: str = "scatter"
     moe_expert_parallel: bool = False
     moe_aux_coef: float = 0.01
 
@@ -347,6 +352,7 @@ class LMTrainer:
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_num_groups=cfg.moe_groups,
+            moe_dispatch=cfg.moe_dispatch,
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             remat=cfg.remat,

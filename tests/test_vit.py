@@ -20,6 +20,27 @@ def test_vit_forward_shape_and_no_batch_stats():
     assert logits.dtype == jnp.float32
 
 
+def test_vit_wide_p8_geometry():
+    """The round-5 MXU geometry variant: 17 tokens of d384 at head_dim
+    128, registry-constructible, per-sample FLOPs within 2% of
+    vit_tiny's (so their MFU difference IS the geometry)."""
+    from cs744_pytorch_distributed_tutorial_tpu.models import get_model
+
+    m = get_model("vit_wide_p8", num_classes=10)
+    assert (m.patch_size, m.d_model, m.num_heads) == (8, 384, 3)
+    x = jnp.zeros((2, 32, 32, 3))
+    logits = m.init(jax.random.key(0), x)
+    out = m.apply(logits, x)
+    assert out.shape == (2, 10)
+
+    def flops(d, layers, d_ff, n):
+        return layers * (n * (4 * d * d + 2 * d * d_ff) + 2 * n * n * d)
+
+    tiny = flops(192, 6, 768, 65)
+    wide = flops(384, 6, 1536, 17)
+    assert abs(wide - tiny) / tiny < 0.02, (tiny, wide)
+
+
 def test_vit_rejects_indivisible_patches():
     model = ViT(patch_size=5)
     with pytest.raises(ValueError, match="patch_size"):
