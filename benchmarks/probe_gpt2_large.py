@@ -18,9 +18,38 @@ discounted — flash MFU is understated):
    where flash and remat matter more (attention is 2*S*D of the
    per-layer FLOPs: 17% at T=2048/1024d vs 9% at T=1024).
 
-Results are filled in below after the measured run (this docstring is
-the record of what the sweep found, the same convention as
-probe_gpt2_medium.py).
+Measured 2026-08-01 (one TPU v5e chip through the tunnel; wall-clock
+over STEPS after warmup):
+
+  medium-T2048 unroll+nomat b4   226.3 ms  36.2k tok/s  MFU 0.5006
+  medium-T2048 b8 (unroll/scan x nomat/dots): remote-compile HTTP 500
+  large scan+dots  b1   114.2 ms   9.0k tok/s  MFU 0.237
+  large scan+dots  b2   160.8 ms  12.7k tok/s  MFU 0.336
+  large scan+dots  b3:  remote-compile HTTP 500
+  large scan+nomat b2:  remote-compile HTTP 500
+  large b4..b16, unroll b8 (every variant): remote-compile HTTP 500
+
+Findings:
+- **Context doubles at constant MFU**: medium at T=2048/b4 (the same
+  8192 tokens/step as the T=1024/b8 row) lands at 0.5006 vs 0.510 —
+  the flash path's S-scaling costs ~2% MFU, and the long-context
+  regime keeps the 1024d efficiency. The b8/T2048 point that would
+  test for a 0.52+ peak is COMPILE-WALLED (below), so 0.5006 is the
+  measured long-context ceiling here, not the model's.
+- **The compile-helper wall boundary is now pinned from both sides**:
+  medium-T2048 compiles at b4 and walls at b8 (= the b16/T1024
+  footprint that walled round 4); large compiles at scan+dots b2 and
+  walls at b3-dots AND b2-nomat. The wall tracks TOTAL footprint
+  (activations + 9.3 GB of large's persistent f32 params+moments),
+  not traced-program size — scan_layers (12x smaller program) moves
+  it not at all at 36L.
+- **GPT-2-large through this tunnel is therefore activation-starved**:
+  the only compiling configs (b1/b2 + dots recompute) underfill the
+  MXU (0.237/0.336) exactly as small batches always do. The d-model
+  trend (0.454@768d -> 0.510@1024d) predicts >=0.51 for 1280d at b8
+  remat-off on direct-attached hardware; through this tunnel that
+  remains a prediction — recorded with the probe boundary as evidence,
+  the same class as the round-4 b32 wall.
 """
 
 from __future__ import annotations
