@@ -132,11 +132,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "NonFiniteLossError")
     p.add_argument("--accum-steps", type=int, default=1)
     p.add_argument("--zero1", action="store_true",
-                   help="ZeRO-1: shard both AdamW moments over the data "
-                        "axis (optimizer memory / data_parallel); "
-                        "composes with --tensor-parallel and "
-                        "--grad-clip-norm; requires adamw, no expert "
-                        "parallelism")
+                   help="ZeRO-1: shard the optimizer moments over the "
+                        "data axis (optimizer memory / data_parallel); "
+                        "composes with --tensor-parallel, "
+                        "--grad-clip-norm and all --optimizer rules "
+                        "(adamw/lion/sgd); no expert parallelism")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO-3/FSDP: params AND AdamW moments persist "
                         "as data-axis-sharded chunks, gathered "

@@ -908,9 +908,10 @@ class PipelineLMConfig:
     # layout — parallel/zero.py::Zero1Adam's generalized shard_axes).
     # Optimizer memory per device drops from 2x params to
     # 2x params / data_parallel on TOP of the pipe/tensor sharding.
-    # Requires optimizer="adamw" and no expert parallelism; trajectory
-    # matches the replicated optimizer (tested); resume is mesh-elastic
-    # over data_parallel like the LM engine's.
+    # Carries all three registry rules chunk-wise (adamw / lion — one
+    # sharded moment / sgd); no expert parallelism; trajectory matches
+    # the replicated optimizer (tested); resume is mesh-elastic over
+    # data_parallel like the LM engine's.
     zero1: bool = False
 
     # Checkpoint/resume (Orbax, utils/checkpoint.py): fit()'s batch plan
@@ -1172,18 +1173,15 @@ class PipelineLMTrainer:
             # ZeRO-1 over the data axis, chunked per (pipe[, tensor])
             # coordinate for the sharded block leaves (the generalized
             # Zero1Adam shard_axes layout).
-            for flag, bad, why in (
-                ("optimizer", cfg.optimizer != "adamw",
-                 "the chunked optimizer implements the adamw rule"),
-                ("moe_expert_parallel", self.expert_parallel,
-                 "expert-sharded leaves are not data-replicated"),
-            ):
-                if bad:
-                    raise ValueError(
-                        f"zero1=True is incompatible with {flag} ({why})"
-                    )
+            if self.expert_parallel:
+                raise ValueError(
+                    "zero1=True is incompatible with moe_expert_parallel "
+                    "(expert-sharded leaves are not data-replicated)"
+                )
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 Zero1Adam,
+                Zero1Lion,
+                Zero1SgdLM,
                 chunk_local_sizes,
                 make_elastic_adapt,
             )
@@ -1195,8 +1193,21 @@ class PipelineLMTrainer:
             if has_tensor:
                 shard_axes[TENSOR_AXIS] = self.tensor_size
             self.tx = None
-            self._zero1_opt = Zero1Adam(
-                make_schedule(cfg), b1=cfg.momentum, b2=0.999, eps=1e-8,
+            # All three registry rules run chunk-wise (the LM engine's
+            # round-5 family; b2 defaults mirror make_optimizer's).
+            try:
+                opt_cls, b2 = {
+                    "adamw": (Zero1Adam, 0.999),
+                    "lion": (Zero1Lion, 0.99),
+                    "sgd": (Zero1SgdLM, 0.0),
+                }[cfg.optimizer]
+            except KeyError:
+                raise ValueError(
+                    f"unknown optimizer {cfg.optimizer!r}; choose from "
+                    "('sgd', 'adamw', 'lion')"
+                ) from None
+            self._zero1_opt = opt_cls(
+                make_schedule(cfg), b1=cfg.momentum, b2=b2, eps=1e-8,
                 weight_decay=cfg.weight_decay, axis_name=DATA_AXIS,
                 axis_size=self.data_size,
                 seq_axis=SEQ_AXIS if self.seq_size > 1 else None,
@@ -1211,10 +1222,9 @@ class PipelineLMTrainer:
                 param_shapes, self.param_specs,
             )
             self.opt_specs = {
-                "mu": moment_specs,
-                "nu": moment_specs,
-                "count": P(),
+                name: moment_specs for name in opt_cls.MOMENTS
             }
+            self.opt_specs["count"] = P()
             # Mesh-elastic resume: moment chunks re-chunk across
             # data_parallel sizes; (pipe[, tensor]) coordinates are
             # layout-pinned (parallel/zero.py::make_elastic_adapt).

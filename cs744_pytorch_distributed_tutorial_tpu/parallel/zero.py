@@ -385,6 +385,11 @@ class Zero1Adam:
             raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
         self.clip_norm = clip_norm
 
+    #: Sharded moment collections this rule carries (subclasses with
+    #: single-moment rules — lion, sgd — override; the elastic-resume
+    #: adapt and the trainer's opt_specs key off these names).
+    MOMENTS: tuple = ("mu", "nu")
+
     def _chunk(self, size: int) -> int:
         return -(-size // self.axis_size)  # ceil
 
@@ -410,12 +415,12 @@ class Zero1Adam:
                 (self.axis_size, *sizes, self._chunk(local)), jnp.float32
             )
 
-        moment = lambda: jax.tree.map(leaf, params, specs)
-        return {
-            "mu": moment(),
-            "nu": moment(),
-            "count": jnp.zeros((), jnp.int32),
+        state = {
+            name: jax.tree.map(leaf, params, specs)
+            for name in self.MOMENTS
         }
+        state["count"] = jnp.zeros((), jnp.int32)
+        return state
 
     def _step_scalars(self, state):
         """(incremented count, lr, bias corrections) for one update.
@@ -443,6 +448,16 @@ class Zero1Adam:
             + self.weight_decay * p_mine
         )
         return mu_n, nu_n, update
+
+    def _chunk_rule(self, p_mine, moms, g_mine, c1, c2):
+        """Moment-agnostic dispatch point for the update rule: takes the
+        f32 chunks (param, [moments in MOMENTS order], mean grad) and
+        returns ([new moments], update) — the caller scales by -lr.
+        Subclasses override for single-moment rules (lion, sgd)."""
+        mu_n, nu_n, update = self._adamw_chunk_update(
+            p_mine, moms[0], moms[1], g_mine, c1, c2
+        )
+        return [mu_n, nu_n], update
 
     def _mean_chunk(self, g, spec):
         """Inside shard_map: LOCAL (pre-sync) grad leaf -> this device's
@@ -500,7 +515,7 @@ class Zero1Adam:
         )
 
     def apply(self, params, state, grads, specs=None):
-        """One ZeRO-1 AdamW step from LOCAL (pre-sync) grads: returns
+        """One ZeRO-1 step from LOCAL (pre-sync) grads: returns
         (replicated new params, new state with local moment shards).
         ``specs`` is the param PartitionSpec tree (tensor-sharded leaves
         chunk their LOCAL shard; omit for all-replicated)."""
@@ -511,7 +526,7 @@ class Zero1Adam:
         chunks = jax.tree.map(self._mean_chunk, grads, specs)
         chunks = self._clip_chunks(chunks, specs)
 
-        def leaf(p, mu, nu, g_mine):
+        def leaf(p, g_mine, *moms):
             chunk = g_mine.shape[-1]
             pad = s * chunk - p.size
             p2d = jnp.pad(
@@ -520,23 +535,27 @@ class Zero1Adam:
             p_mine = lax.dynamic_index_in_dim(
                 p2d, lax.axis_index(self.axis_name), 0, keepdims=False
             )
-            mu_n, nu_n, update = self._adamw_chunk_update(
-                p_mine, mu.reshape(chunk), nu.reshape(chunk), g_mine, c1, c2
+            new_moms, update = self._chunk_rule(
+                p_mine, [m.reshape(chunk) for m in moms], g_mine, c1, c2
             )
             delta_mine = -lr * update
             delta = lax.all_gather(delta_mine, self.axis_name, axis=0)
             new_p = (p.ravel().astype(jnp.float32) + delta.reshape(-1)[: p.size])
             return (
                 new_p.reshape(p.shape).astype(p.dtype),
-                mu_n.reshape(mu.shape),
-                nu_n.reshape(nu.shape),
+                *[nm.reshape(m.shape) for nm, m in zip(new_moms, moms)],
             )
 
-        out = jax.tree.map(leaf, params, state["mu"], state["nu"], chunks)
+        out = jax.tree.map(
+            leaf, params, chunks, *[state[n] for n in self.MOMENTS]
+        )
         pick = lambda i: jax.tree.map(
             lambda _, o: o[i], params, out
         )
-        return pick(0), {"mu": pick(1), "nu": pick(2), "count": count}
+        new_state = {"count": count}
+        for i, name in enumerate(self.MOMENTS):
+            new_state[name] = pick(1 + i)
+        return pick(0), new_state
 
 
 class FsdpAdam(Zero1Adam):
@@ -662,29 +681,75 @@ class FsdpAdam(Zero1Adam):
         return g_mine
 
     def apply(self, param_shards, state, grad_chunks, specs=None):
-        """One FSDP AdamW step from CHUNKED grad sums: mean-ify (and
+        """One FSDP step from CHUNKED grad sums: mean-ify (and
         optionally clip, ``_clip_chunks``) the chunks, then run the
-        shared AdamW chunk rule on the local shards."""
+        shared chunk rule on the local shards."""
         count, lr, c1, c2 = self._step_scalars(state)
         if specs is None:
             specs = _replicated_specs(param_shards)
         chunks = jax.tree.map(self._mean_chunk, grad_chunks, specs)
         chunks = self._clip_chunks(chunks, specs)
 
-        def leaf(psh, mu, nu, g_mine):
+        def leaf(psh, g_mine, *moms):
             chunk = psh.shape[-1]
             p_mine = psh.reshape(chunk).astype(jnp.float32)
-            mu_n, nu_n, update = self._adamw_chunk_update(
-                p_mine, mu.reshape(chunk), nu.reshape(chunk), g_mine, c1, c2
+            new_moms, update = self._chunk_rule(
+                p_mine, [m.reshape(chunk) for m in moms], g_mine, c1, c2
             )
             new_p = (p_mine - lr * update).astype(psh.dtype)
             return (
                 new_p.reshape(psh.shape),
-                mu_n.reshape(mu.shape),
-                nu_n.reshape(nu.shape),
+                *[nm.reshape(m.shape) for nm, m in zip(new_moms, moms)],
             )
 
-        out = jax.tree.map(leaf, param_shards, state["mu"], state["nu"],
-                           chunks)
+        out = jax.tree.map(
+            leaf, param_shards, chunks, *[state[n] for n in self.MOMENTS]
+        )
         pick = lambda i: jax.tree.map(lambda _, o: o[i], param_shards, out)
-        return pick(0), {"mu": pick(1), "nu": pick(2), "count": count}
+        new_state = {"count": count}
+        for i, name in enumerate(self.MOMENTS):
+            new_state[name] = pick(1 + i)
+        return pick(0), new_state
+
+
+class Zero1Lion(Zero1Adam):
+    """ZeRO-1 Lion for the LM engine (round 5 — the roadmap's
+    "mechanical" extension of the factored chunk rule): ONE sharded
+    moment instead of Adam's two, so optimizer memory is
+    params / axis_size per device — Lion's halved-state advantage
+    stacks with the ZeRO sharding. The rule is optax.lion's exactly
+    (sign momentum interpolation, decoupled decay, no bias
+    correction), applied chunk-wise; ``eps`` is unused. Constructor,
+    layout, clipping and the elastic resume all inherit from
+    ``Zero1Adam``."""
+
+    MOMENTS = ("mu",)
+
+    def _chunk_rule(self, p_mine, moms, g_mine, c1, c2):
+        del c1, c2  # no bias correction in lion
+        (mu,) = moms
+        update = (
+            jnp.sign(self.b1 * mu + (1.0 - self.b1) * g_mine)
+            + self.weight_decay * p_mine
+        )
+        mu_n = self.b2 * mu + (1.0 - self.b2) * g_mine
+        return [mu_n], update
+
+
+class Zero1SgdLM(Zero1Adam):
+    """ZeRO-1 SGD(momentum, weight-decay) for the LM/pipeline engines,
+    matching ``train/state.py::make_optimizer``'s torch-SGD chain
+    (add_decayed_weights -> trace -> scale_by_lr): decay folds into
+    the gradient BEFORE the momentum trace. One sharded moment;
+    ``b2``/``eps`` are unused (``b1`` is the momentum). The "LM"
+    suffix keeps it visually distinct from the CIFAR engine's
+    ``Zero1SGD`` above (different constructor and layout contract)."""
+
+    MOMENTS = ("mu",)
+
+    def _chunk_rule(self, p_mine, moms, g_mine, c1, c2):
+        del c1, c2
+        (mu,) = moms
+        g_eff = g_mine + self.weight_decay * p_mine
+        mu_n = self.b1 * mu + g_eff
+        return [mu_n], mu_n

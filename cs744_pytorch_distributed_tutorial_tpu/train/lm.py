@@ -160,9 +160,10 @@ class LMConfig:
     # the same total bytes as the allreduce it replaces. Trajectory
     # matches the replicated optimizer to float tolerance (tested).
     # Composes with tensor_parallel (local tensor shards chunk per
-    # (data, tensor) coordinate) and grad_clip_norm (exact global norm
-    # via one psum of per-chunk squared sums). Requires
-    # optimizer="adamw" and no expert parallelism. Checkpoint resume is
+    # (data, tensor) coordinate), grad_clip_norm (exact global norm
+    # via one psum of per-chunk squared sums), and all three registry
+    # optimizers (adamw / lion — one sharded moment / sgd). No expert
+    # parallelism. Checkpoint resume is
     # mesh-ELASTIC over data_parallel (round 5): flat chunks re-chunk
     # on restore ([dp_old, c_old] -> [dp_new, c_new], host-side);
     # tensor_parallel is layout-pinned and must match the save.
@@ -403,8 +404,8 @@ class LMTrainer:
             # scatter.
             which = "fsdp" if cfg.fsdp else "zero1"
             for flag, bad, why in (
-                ("optimizer", cfg.optimizer != "adamw",
-                 "the chunked optimizer implements the adamw rule"),
+                ("optimizer", cfg.fsdp and cfg.optimizer != "adamw",
+                 "the fsdp param-chunk path implements the adamw rule"),
                 ("moe_expert_parallel", self.expert_parallel,
                  "expert-sharded leaves are not data-replicated"),
             ):
@@ -416,6 +417,8 @@ class LMTrainer:
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpAdam,
                 Zero1Adam,
+                Zero1Lion,
+                Zero1SgdLM,
                 spec_dim,
             )
             from cs744_pytorch_distributed_tutorial_tpu.train.state import (
@@ -423,9 +426,25 @@ class LMTrainer:
             )
 
             self.tx = None
-            opt_cls = FsdpAdam if cfg.fsdp else Zero1Adam
+            # zero1 carries all three registry rules chunk-wise (round
+            # 5 — lion halves the sharded state, sgd matches the
+            # torch-SGD chain); the b2 defaults mirror make_optimizer's
+            # optax constructors.
+            try:
+                opt_cls, b2 = {
+                    "adamw": (Zero1Adam, 0.999),
+                    "lion": (Zero1Lion, 0.99),
+                    "sgd": (Zero1SgdLM, 0.0),
+                }[cfg.optimizer]
+            except KeyError:
+                raise ValueError(
+                    f"unknown optimizer {cfg.optimizer!r}; choose from "
+                    "('sgd', 'adamw', 'lion')"
+                ) from None
+            if cfg.fsdp:
+                opt_cls, b2 = FsdpAdam, 0.999
             self._zero1_opt = opt_cls(
-                make_schedule(cfg), b1=cfg.momentum, b2=0.999, eps=1e-8,
+                make_schedule(cfg), b1=cfg.momentum, b2=b2, eps=1e-8,
                 weight_decay=cfg.weight_decay, axis_name=DATA_AXIS,
                 axis_size=self.data_size, seq_axis=SEQ_AXIS,
                 seq_size=self.seq_size,
@@ -453,10 +472,9 @@ class LMTrainer:
                 chunk_spec, param_shapes, self._orig_param_specs
             )
             self.opt_specs = {
-                "mu": moment_specs,
-                "nu": moment_specs,
-                "count": P(),
+                name: moment_specs for name in opt_cls.MOMENTS
             }
+            self.opt_specs["count"] = P()
             # Mesh-elastic resume: re-chunk flat [dp_old(, tp), chunk]
             # checkpoint state to the current data_parallel's layout
             # (parallel/zero.py::make_elastic_adapt; moments always,

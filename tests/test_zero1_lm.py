@@ -102,11 +102,11 @@ def test_zero1_moments_are_sharded():
 
 def test_zero1_rejections():
     """What remains rejected after the round-5 compositions: non-adamw
-    rules and expert parallelism (all_to_all grad layout does not fit
-    the flat-chunk scatter)."""
+    rules under FSDP (the param-chunk path), and expert parallelism
+    (all_to_all grad layout does not fit the flat-chunk scatter)."""
     mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="adamw"):
-        LMTrainer(_cfg(data_parallel=2, zero1=True, optimizer="sgd"),
+        LMTrainer(_cfg(data_parallel=2, fsdp=True, optimizer="sgd"),
                   mesh=mesh)
     with pytest.raises(ValueError, match="expert"):
         LMTrainer(
@@ -114,6 +114,25 @@ def test_zero1_rejections():
                  moe_expert_parallel=True),
             mesh=mesh,
         )
+
+
+@pytest.mark.parametrize("opt", ["lion", "sgd"])
+def test_zero1_lion_sgd_trajectory_matches_replicated(opt):
+    """Round 5: zero1 carries all three registry rules chunk-wise —
+    lion (ONE sharded moment: Lion's halved state stacks with the
+    ZeRO sharding) and torch-chain sgd match their replicated optax
+    trajectories, here composed with tp2 + clipping so the chunk
+    layout and the exact-norm clip run under the non-adamw rules
+    too."""
+    mesh = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                     devices=jax.devices()[:4])
+    kw = dict(data_parallel=2, tensor_parallel=2, optimizer=opt,
+              grad_clip_norm=0.05, learning_rate=1e-3)
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    tr, _, z_opt, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+    # Single-moment rules carry ONE sharded collection, not two.
+    assert set(z_opt) == {"mu", "count"}
 
 
 # --------------------------------------------------------------------------
