@@ -138,8 +138,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "--grad-clip-norm and all --optimizer rules "
                         "(adamw/lion/sgd); no expert parallelism")
     p.add_argument("--fsdp", action="store_true",
-                   help="ZeRO-3/FSDP: params AND AdamW moments persist "
-                        "as data-axis-sharded chunks, gathered "
+                   help="ZeRO-3/FSDP: params AND optimizer moments "
+                        "persist as data-axis-sharded chunks, gathered "
                         "just-in-time per step (3x-params state / "
                         "data_parallel); same compositions and "
                         "restrictions as --zero1")

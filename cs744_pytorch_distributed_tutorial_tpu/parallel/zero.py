@@ -753,3 +753,16 @@ class Zero1SgdLM(Zero1Adam):
         g_eff = g_mine + self.weight_decay * p_mine
         mu_n = self.b1 * mu + g_eff
         return [mu_n], mu_n
+
+
+class FsdpLion(FsdpAdam, Zero1Lion):
+    """ZeRO-3/FSDP with the Lion chunk rule: params + ONE moment as
+    data-sharded flat chunks (2x params of persistent state ->
+    2x params / axis_size). Pure MRO composition — ``FsdpAdam``
+    supplies the param-chunk machinery (shard/gather/unshard, chunked
+    apply), ``Zero1Lion`` the single-moment rule."""
+
+
+class FsdpSgdLM(FsdpAdam, Zero1SgdLM):
+    """ZeRO-3/FSDP with the torch-SGD chunk rule (params + momentum
+    chunks; same MRO composition as ``FsdpLion``)."""

@@ -174,9 +174,10 @@ class LMConfig:
     # of persistent state becomes 3x params / data_parallel per device.
     # Full weights exist only transiently inside the step (one
     # all_gather per leaf, freed after last use; the all_gather's AD
-    # transpose delivers grads pre-scattered). Same restrictions as
-    # zero1, same trajectory-parity guarantee; params leave fit() as
-    # chunked arrays (gather_for_decode unshards them).
+    # transpose delivers grads pre-scattered). Same compositions and
+    # restrictions as zero1 (all three optimizer rules via
+    # FsdpLion/FsdpSgdLM), same trajectory-parity guarantee; params
+    # leave fit() as chunked arrays (gather_for_decode unshards them).
     fsdp: bool = False
 
     # Layer stacking (models/transformer.py::TransformerLM.scan_layers):
@@ -403,19 +404,16 @@ class LMTrainer:
             # their all_to_all grad layout doesn't fit the flat-chunk
             # scatter.
             which = "fsdp" if cfg.fsdp else "zero1"
-            for flag, bad, why in (
-                ("optimizer", cfg.fsdp and cfg.optimizer != "adamw",
-                 "the fsdp param-chunk path implements the adamw rule"),
-                ("moe_expert_parallel", self.expert_parallel,
-                 "expert-sharded leaves are not data-replicated"),
-            ):
-                if bad:
-                    raise ValueError(
-                        f"{which}=True is incompatible with {flag} "
-                        f"({why})"
-                    )
+            if self.expert_parallel:
+                raise ValueError(
+                    f"{which}=True is incompatible with "
+                    "moe_expert_parallel (expert-sharded leaves are not "
+                    "data-replicated)"
+                )
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpAdam,
+                FsdpLion,
+                FsdpSgdLM,
                 Zero1Adam,
                 Zero1Lion,
                 Zero1SgdLM,
@@ -430,19 +428,19 @@ class LMTrainer:
             # 5 — lion halves the sharded state, sgd matches the
             # torch-SGD chain); the b2 defaults mirror make_optimizer's
             # optax constructors.
+            rules = {
+                "adamw": ((Zero1Adam, FsdpAdam), 0.999),
+                "lion": ((Zero1Lion, FsdpLion), 0.99),
+                "sgd": ((Zero1SgdLM, FsdpSgdLM), 0.0),
+            }
             try:
-                opt_cls, b2 = {
-                    "adamw": (Zero1Adam, 0.999),
-                    "lion": (Zero1Lion, 0.99),
-                    "sgd": (Zero1SgdLM, 0.0),
-                }[cfg.optimizer]
+                (z1_cls, fsdp_cls), b2 = rules[cfg.optimizer]
             except KeyError:
                 raise ValueError(
                     f"unknown optimizer {cfg.optimizer!r}; choose from "
                     "('sgd', 'adamw', 'lion')"
                 ) from None
-            if cfg.fsdp:
-                opt_cls, b2 = FsdpAdam, 0.999
+            opt_cls = fsdp_cls if cfg.fsdp else z1_cls
             self._zero1_opt = opt_cls(
                 make_schedule(cfg), b1=cfg.momentum, b2=b2, eps=1e-8,
                 weight_decay=cfg.weight_decay, axis_name=DATA_AXIS,

@@ -101,12 +101,13 @@ def test_zero1_moments_are_sharded():
 
 
 def test_zero1_rejections():
-    """What remains rejected after the round-5 compositions: non-adamw
-    rules under FSDP (the param-chunk path), and expert parallelism
-    (all_to_all grad layout does not fit the flat-chunk scatter)."""
+    """What remains rejected after the round-5 compositions: unknown
+    optimizer strings (friendly error, not a KeyError) and expert
+    parallelism (all_to_all grad layout does not fit the flat-chunk
+    scatter)."""
     mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="adamw"):
-        LMTrainer(_cfg(data_parallel=2, fsdp=True, optimizer="sgd"),
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        LMTrainer(_cfg(data_parallel=2, zero1=True, optimizer="adam"),
                   mesh=mesh)
     with pytest.raises(ValueError, match="expert"):
         LMTrainer(
@@ -133,6 +134,25 @@ def test_zero1_lion_sgd_trajectory_matches_replicated(opt):
     np.testing.assert_allclose(base, z1, rtol=2e-5)
     # Single-moment rules carry ONE sharded collection, not two.
     assert set(z_opt) == {"mu", "count"}
+
+
+@pytest.mark.parametrize("opt", ["lion", "sgd"])
+def test_fsdp_lion_sgd_trajectory_matches_replicated(opt):
+    """FSDP runs the same rule family (MRO composition FsdpLion /
+    FsdpSgdLM): chunked params + single-moment state still match the
+    replicated optax trajectory, and decode unshards."""
+    mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
+    kw = dict(data_parallel=2, optimizer=opt, learning_rate=1e-3)
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    tr, params, f_opt, f = _run(_cfg(**kw, fsdp=True), mesh)
+    np.testing.assert_allclose(base, f, rtol=2e-5)
+    assert set(f_opt) == {"mu", "count"}
+    host = tr.gather_for_decode(params)
+    toks = jnp.asarray(
+        synthetic_tokens(2, 16, 64, seed=3)[:, :16], jnp.int32
+    )
+    logits = tr.decode_model().apply({"params": host}, toks)
+    assert np.isfinite(np.asarray(logits)).all()
 
 
 # --------------------------------------------------------------------------
