@@ -213,6 +213,16 @@ def bench_moe(batch: int = 32, seq: int = 512) -> list[dict]:
         ("moe_e8_top2_g1_scatter_cf1",
          dict(d_ff=1024, moe_experts=8, moe_top_k=2,
               moe_dispatch="scatter", moe_capacity_factor=1.0)),
+        # Dropless (late round 5): NO capacity slots — argsort by
+        # expert + two ragged grouped matmuls (ops/gmm.py); expert
+        # FLOPs are exactly k*N rows (the cf=1.0 scatter row's compute
+        # without its drops). Both gmm backends measured.
+        ("moe_e8_top2_dropless_ragged",
+         dict(d_ff=1024, moe_experts=8, moe_top_k=2,
+              moe_dispatch="dropless")),
+        ("moe_e8_top2_dropless_pallas",
+         dict(d_ff=1024, moe_experts=8, moe_top_k=2,
+              moe_dispatch="dropless", moe_gmm_impl="pallas")),
         ("dense_matched", dict(d_ff=2048)),
     ):
         cfg = LMConfig(**base, **kw)

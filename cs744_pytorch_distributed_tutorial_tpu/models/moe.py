@@ -79,8 +79,8 @@ class MoEFFN(nn.Module):
     num_groups: int = 1
     # Token movement implementation (round 5, VERDICT r4 #6 — the
     # 1.41x residual routed-vs-dense tax lived in the dispatch/combine
-    # one-hot einsums). Routing, priority, capacity and drop semantics
-    # are IDENTICAL across the two (the same cumsum-derived slot
+    # one-hot einsums). "einsum" and "scatter" share routing, priority,
+    # capacity and drop semantics (the same cumsum-derived slot
     # positions drive both); only how tokens reach their slots differs:
     # - "einsum": dense [G,N,E,C] dispatch/combine one-hot contractions
     #   (MXU work, O(N*E*C*D) per group — the GShard formulation);
@@ -88,7 +88,23 @@ class MoEFFN(nn.Module):
     #   gather+weight the outputs back (O(N*K*D) per group — the
     #   sort-free equivalent of sort-based/ragged dispatch; AD
     #   transposes scatter<->gather, so gradients route for free).
+    # - "dropless": NO capacity — megablocks-style semantics. Tokens
+    #   argsort by expert into contiguous ragged groups (static shapes,
+    #   dynamic counts) and the expert FFN runs as two grouped matmuls
+    #   (``ops/gmm.py``: lax.ragged_dot or the Pallas gmm kernel, per
+    #   ``gmm_impl``). Every routed token computes — ``moe_drop`` is
+    #   identically 0 and ``capacity_factor``/``num_groups`` are
+    #   ignored. Does NOT compose with ``expert_axis``: EP's all_to_all
+    #   needs static per-destination counts, which is exactly what
+    #   capacity slots buy — dropless + EP would reintroduce them.
     dispatch_impl: str = "scatter"
+    # Grouped-matmul backend for dispatch_impl="dropless": "ragged"
+    # (XLA's lax.ragged_dot) or "pallas" (the megablox-style kernel).
+    gmm_impl: str = "ragged"
+    gmm_block_m: int = 256
+    gmm_block_n: int = 512
+    # None = interpret Pallas kernels off-TPU (ops/_backend.py).
+    gmm_interpret: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -97,7 +113,20 @@ class MoEFFN(nn.Module):
         k = self.top_k
         if k < 1 or k > e:
             raise ValueError(f"top_k {k} must be in [1, {e}]")
+        if self.dispatch_impl not in ("einsum", "scatter", "dropless"):
+            raise ValueError(
+                f"unknown dispatch_impl {self.dispatch_impl!r}; "
+                "choose 'einsum', 'scatter' or 'dropless'"
+            )
+        dropless = self.dispatch_impl == "dropless"
         ep = self.expert_axis is not None and self.expert_axis_size > 1
+        if dropless and ep:
+            raise ValueError(
+                "dispatch_impl='dropless' does not compose with "
+                "expert_axis: EP's all_to_all needs static per-"
+                "destination counts (capacity slots); use 'scatter' or "
+                "'einsum' for expert-parallel layouts"
+            )
         if e % (self.expert_axis_size if ep else 1):
             raise ValueError(
                 f"num_experts {e} not divisible by expert axis "
@@ -108,7 +137,9 @@ class MoEFFN(nn.Module):
         g = self.num_groups
         if g < 0:
             raise ValueError(f"num_groups must be >= 0, got {g}")
-        if g == 0:  # auto: ~1024 tokens per group
+        if dropless:
+            g = 1  # grouping exists to bound capacity; dropless has none
+        elif g == 0:  # auto: ~1024 tokens per group
             g = max(1, n_total // 1024)
         # Effective groups: the largest divisor of N at most the request
         # — a decode/prefill call (N as small as 1) must not trip over a
@@ -147,6 +178,61 @@ class MoEFFN(nn.Module):
         )
         self.sow("losses", "moe_aux", aux)
 
+        # ---- expert parameters (shared by every dispatch path) ----------
+        init = nn.initializers.lecun_normal()
+        w_in = self.param("w_in", init, (e_local, d, self.d_ff))
+        b_in = self.param(
+            "b_in", nn.initializers.zeros_init(), (e_local, self.d_ff)
+        )
+        w_out = self.param("w_out", init, (e_local, self.d_ff, d))
+        b_out = self.param("b_out", nn.initializers.zeros_init(), (e_local, d))
+
+        if dropless:
+            # ---- dropless: sort by expert, ragged grouped matmuls -------
+            # Every routed (token, k) pair computes — no capacity, no
+            # drops. argsort is stable, so within an expert tokens keep
+            # batch order (irrelevant to math, deterministic for tests).
+            from cs744_pytorch_distributed_tutorial_tpu.ops._backend import (
+                default_interpret,
+            )
+            from cs744_pytorch_distributed_tutorial_tpu.ops.gmm import (
+                grouped_matmul,
+            )
+
+            interpret = (
+                default_interpret()
+                if self.gmm_interpret is None
+                else bool(self.gmm_interpret)
+            )
+            p_tot = n_total * k
+            expert_flat = topk_idx.reshape(p_tot)
+            order = jnp.argsort(expert_flat, stable=True)
+            sorted_e = expert_flat[order]
+            group_sizes = jnp.bincount(expert_flat, length=e)
+            tok_ids = order // k  # pair -> owning token row
+            xs = tokens.reshape(n_total, d)[tok_ids].astype(self.dtype)
+            gmm = lambda lhs, rhs: grouped_matmul(
+                lhs,
+                rhs,
+                group_sizes,
+                impl=self.gmm_impl,
+                block_m=self.gmm_block_m,
+                block_n=self.gmm_block_n,
+                interpret=interpret,
+            )
+            h = gmm(xs, w_in.astype(self.dtype))
+            h = nn.gelu(h + b_in[sorted_e].astype(h.dtype))
+            out = gmm(h.astype(self.dtype), w_out.astype(self.dtype))
+            out = out + b_out[sorted_e].astype(out.dtype)
+            self.sow("metrics", "moe_drop", jnp.float32(0.0))
+            gate_flat = topk_gate.reshape(p_tot)[order].astype(out.dtype)
+            y = (
+                jnp.zeros((n_total, d), out.dtype)
+                .at[tok_ids]
+                .add(out * gate_flat[:, None])
+            )
+            return y.reshape(b, t, d).astype(self.dtype)
+
         # ---- capacity-slot assignment (static shapes, per group) --------
         # Priority: rank-0 choices of every token beat rank-1 choices
         # (k-major cumsum order), so top-1 routes are the last to drop.
@@ -162,11 +248,6 @@ class MoEFFN(nn.Module):
         # not. Callers that pass mutable=["metrics"] receive it; others
         # (the pipeline stage fn) silently drop it, by flax's contract.
         self.sow("metrics", "moe_drop", 1.0 - keep.mean())
-        if self.dispatch_impl not in ("einsum", "scatter"):
-            raise ValueError(
-                f"unknown dispatch_impl {self.dispatch_impl!r}; "
-                "choose 'einsum' or 'scatter'"
-            )
         scatter = self.dispatch_impl == "scatter"
         if scatter:
             # ---- scatter tokens into expert slot blocks -----------------
@@ -214,11 +295,6 @@ class MoEFFN(nn.Module):
             )  # [E_local, S*G*C, D]
 
         # ---- batched expert FFN -----------------------------------------
-        init = nn.initializers.lecun_normal()
-        w_in = self.param("w_in", init, (e_local, d, self.d_ff))
-        b_in = self.param("b_in", nn.initializers.zeros_init(), (e_local, self.d_ff))
-        w_out = self.param("w_out", init, (e_local, self.d_ff, d))
-        b_out = self.param("b_out", nn.initializers.zeros_init(), (e_local, d))
         h = jnp.einsum(
             "ecd,edf->ecf", expert_in, w_in.astype(self.dtype)
         ) + b_in[:, None, :].astype(self.dtype)

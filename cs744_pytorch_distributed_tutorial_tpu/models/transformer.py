@@ -437,7 +437,10 @@ class Block(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_num_groups: int = 1
-    moe_dispatch: str = "scatter"  # token movement: einsum | scatter
+    # token movement: einsum | scatter | dropless (no capacity — ragged
+    # grouped matmuls, ops/gmm.py)
+    moe_dispatch: str = "scatter"
+    moe_gmm_impl: str = "ragged"  # dropless backend: ragged | pallas
     expert_axis: str | None = None
     expert_axis_size: int = 1
     max_decode_len: int | None = None
@@ -540,6 +543,8 @@ class Block(nn.Module):
                 capacity_factor=self.moe_capacity_factor,
                 num_groups=self.moe_num_groups,
                 dispatch_impl=self.moe_dispatch,
+                gmm_impl=self.moe_gmm_impl,
+                gmm_interpret=self.flash_interpret,
                 dtype=self.dtype,
                 expert_axis=self.expert_axis,
                 expert_axis_size=self.expert_axis_size,
@@ -603,7 +608,9 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_num_groups: int = 1
-    moe_dispatch: str = "scatter"  # token movement: einsum | scatter
+    # token movement: einsum | scatter | dropless (ops/gmm.py)
+    moe_dispatch: str = "scatter"
+    moe_gmm_impl: str = "ragged"
     expert_axis: str | None = None
     expert_axis_size: int = 1
     # Rematerialization: recompute each block's activations during the
@@ -728,6 +735,7 @@ class TransformerLM(nn.Module):
             moe_capacity_factor=self.moe_capacity_factor,
             moe_num_groups=self.moe_num_groups,
             moe_dispatch=self.moe_dispatch,
+            moe_gmm_impl=self.moe_gmm_impl,
             expert_axis=self.expert_axis,
             expert_axis_size=self.expert_axis_size,
             max_decode_len=self.max_seq_len,

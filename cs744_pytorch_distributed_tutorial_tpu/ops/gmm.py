@@ -1,0 +1,322 @@
+"""Grouped (ragged) matmul — the compute core of dropless MoE.
+
+The reference has no MoE (data parallelism over one dense VGG-11 is its
+whole scope, SURVEY §2.3); this module extends the framework's
+expert-parallel family with the *dropless* formulation: tokens sorted by
+expert form E contiguous row groups of **data-dependent** sizes, and each
+group multiplies its own expert matrix —
+
+    out[start_e : end_e] = lhs[start_e : end_e] @ rhs[e]
+
+with ``group_sizes`` a traced ``[E]`` vector (static SHAPES, dynamic
+row counts — the XLA-compatible middle ground between the capacity-slot
+formulation's fixed padding and torch-style fully dynamic dispatch).
+
+Two implementations, parity-tested against each other and a dense
+oracle:
+
+- ``impl="ragged"`` — ``jax.lax.ragged_dot``: XLA's native ragged
+  contraction, differentiable out of the box.
+- ``impl="pallas"`` — a megablocks-style TPU kernel (`gmm`), grid over
+  (n-tile, visit-step) with scalar-prefetched step→(row-tile, group)
+  maps: each group's row span is walked tile by tile, boundary tiles are
+  row-masked, and output tiles accumulate in VMEM across the consecutive
+  steps that share them (grid iteration on TPU is sequential, so a
+  revisited output block stays resident). The backward pair is
+  ``dx = gmm(dout, rhsᵀ)`` (same kernel, transposed experts) and
+  ``dw = tgmm`` (per-group ``lhsᵀ @ dout``, same step maps, output
+  block keyed by group) under ``jax.custom_vjp``.
+
+The step count is the static upper bound ``M/block_m + E - 1`` (each
+group boundary adds at most one revisited row tile); unused trailing
+steps are masked off with a prefetched validity flag, costing at most
+``E - 1`` wasted tile-matmuls — noise next to the ``M·K·N`` useful work.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu ships with standard JAX builds (interpret mode uses its
+    # grid spec too); a build without it gets a loud error in
+    # _require_pltpu instead of Mosaic-compiling anything.
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _step_maps(group_sizes, m_padded: int, block_m: int, num_steps: int):
+    """Traced step→(group, row-tile) maps for the visit schedule.
+
+    ``group_sizes`` must sum to ``m_padded`` (the wrapper folds padding
+    into the last group). Returns int32 arrays of length ``num_steps``:
+    ``sg`` (group id), ``sm`` (row-tile id), ``first`` (1 where this
+    step is its row tile's first visit — zero-initialize the output
+    block), ``valid`` (0 for trailing dummy steps), plus per-group
+    ``start``/``end`` row offsets for in-kernel row masking.
+    """
+    e = group_sizes.shape[0]
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes, dtype=jnp.int32)]
+    )
+    start, end = offs[:-1], offs[1:]
+    nonempty = end > start
+    first_tile = start // block_m
+    tiles = jnp.where(nonempty, -((-end) // block_m) - first_tile, 0)
+    step_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(tiles, dtype=jnp.int32)]
+    )
+    total = step_start[-1]
+    s = jnp.arange(num_steps, dtype=jnp.int32)
+    sg = jnp.searchsorted(step_start[1:], s, side="right").astype(jnp.int32)
+    sg = jnp.clip(sg, 0, e - 1)
+    sm = first_tile[sg] + (s - step_start[sg])
+    # Trailing dummy steps repeat the LAST real step's (group, tile) so
+    # they never look like a fresh first-visit; `valid` masks their
+    # contribution (the last real tile would otherwise double-count).
+    last = jnp.maximum(total - 1, 0)
+    sg = jnp.where(s < total, sg, sg[last])
+    sm = jnp.clip(jnp.where(s < total, sm, sm[last]), 0, m_padded // block_m - 1)
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), sm[:-1]])
+    first = ((sm != prev) & (s < total)).astype(jnp.int32)
+    valid = (s < total).astype(jnp.int32)
+    return sg, sm, first, valid, start, end
+
+
+def _row_mask(row0, start_g, end_g, block_m: int):
+    ids = row0 + lax.broadcasted_iota(jnp.int32, (block_m, 1), 0)
+    return (ids >= start_g) & (ids < end_g)
+
+
+def _gmm_kernel(block_m: int, sg, sm, first, valid, start, end,
+                lhs_ref, rhs_ref, out_ref):
+    s = pl.program_id(1)
+    g = sg[s]
+    mask = _row_mask(sm[s] * block_m, start[g], end[g], block_m)
+    x = jnp.where(mask, lhs_ref[...], jnp.zeros_like(lhs_ref[...]))
+    partial_ = jnp.dot(
+        x, rhs_ref[0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(first[s] == 1)
+    def _init():
+        out_ref[...] = partial_
+
+    @pl.when((first[s] == 0) & (valid[s] == 1))
+    def _acc():
+        out_ref[...] += partial_
+
+
+def _tgmm_kernel(block_m: int, sg, sm, first_g, valid, start, end,
+                 lhs_ref, dout_ref, out_ref):
+    s = pl.program_id(1)
+    g = sg[s]
+    mask = _row_mask(sm[s] * block_m, start[g], end[g], block_m)
+    x = jnp.where(mask, lhs_ref[...], jnp.zeros_like(lhs_ref[...]))
+    partial_ = lax.dot_general(
+        x, dout_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[None]
+
+    @pl.when(first_g[s] == 1)
+    def _init():
+        out_ref[...] = partial_
+
+    @pl.when((first_g[s] == 0) & (valid[s] == 1))
+    def _acc():
+        out_ref[...] += partial_
+
+
+def _pad_rows(x, m_padded: int):
+    m = x.shape[0]
+    if m == m_padded:
+        return x
+    return jnp.pad(x, ((0, m_padded - m), (0, 0)))
+
+
+def _prep(lhs, group_sizes, block_m: int, num_experts: int):
+    """Pad rows to a tile multiple and fold the padding into the LAST
+    group (padded rows compute garbage that the caller's row count
+    slices away; zero lhs rows keep the garbage finite)."""
+    m = lhs.shape[0]
+    m_padded = max(_ceil_to(m, block_m), block_m)
+    lhs = _pad_rows(lhs, m_padded)
+    gs = group_sizes.astype(jnp.int32)
+    gs = gs.at[num_experts - 1].add(m_padded - jnp.sum(gs))
+    return lhs, gs, m_padded
+
+
+def _require_pltpu():
+    """The kernels' grid spec (scalar prefetch) lives in
+    ``jax.experimental.pallas.tpu`` even in interpret mode; builds
+    without that module get a loud redirect instead of an
+    AttributeError on ``None``."""
+    if pltpu is None:
+        raise ValueError(
+            "grouped_matmul(impl='pallas') needs "
+            "jax.experimental.pallas.tpu (unavailable on this JAX "
+            "build); use impl='ragged'"
+        )
+
+
+def _gmm_fwd_impl(lhs, rhs, group_sizes, block_m, block_n, interpret):
+    _require_pltpu()
+    m, k = lhs.shape
+    e, _, n = rhs.shape
+    lhs_p, gs, m_padded = _prep(lhs, group_sizes, block_m, e)
+    bn = min(block_n, n)
+    num_steps = m_padded // block_m + e - 1
+    sg, sm, first, valid, start, end = _step_maps(
+        gs, m_padded, block_m, num_steps
+    )
+    grid = (-(-n // bn), num_steps)
+    n_padded = _ceil_to(n, bn)
+    if n_padded != n:
+        rhs = jnp.pad(rhs, ((0, 0), (0, 0), (0, n_padded - n)))
+    kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda j, s, sg, sm, *_: (sm[s], 0), **kw),
+            pl.BlockSpec((1, k, bn), lambda j, s, sg, sm, *_: (sg[s], 0, j), **kw),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_m, bn), lambda j, s, sg, sm, *_: (sm[s], j), **kw
+        ),
+    )
+    out = pl.pallas_call(
+        partial(_gmm_kernel, block_m),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_padded, n_padded), jnp.float32),
+        interpret=interpret,
+    )(sg, sm, first, valid, start, end, lhs_p, rhs)
+    return out[:m, :n]
+
+
+def _tgmm_impl(lhs, dout, group_sizes, num_experts, block_m, block_n,
+               interpret):
+    """Per-group ``lhsᵀ @ dout`` → ``[E, K, N]`` (the dW of gmm)."""
+    _require_pltpu()
+    m, k = lhs.shape
+    n = dout.shape[1]
+    e = num_experts
+    lhs_p, gs, m_padded = _prep(lhs, group_sizes, block_m, e)
+    dout_p = _pad_rows(dout, m_padded)
+    bn = min(block_n, n)
+    n_padded = _ceil_to(n, bn)
+    if n_padded != n:
+        dout_p = jnp.pad(dout_p, ((0, 0), (0, n_padded - n)))
+    num_steps = m_padded // block_m + e - 1
+    sg, sm, first, valid, start, end = _step_maps(
+        gs, m_padded, block_m, num_steps
+    )
+    # first-visit is per GROUP here (the output block is keyed by sg);
+    # a group's steps are consecutive by construction.
+    prev_g = jnp.concatenate([jnp.full((1,), -1, jnp.int32), sg[:-1]])
+    first_g = ((sg != prev_g) & (valid == 1)).astype(jnp.int32)
+    grid = (-(-n // bn), num_steps)
+    kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda j, s, sg, sm, *_: (sm[s], 0), **kw),
+            pl.BlockSpec((block_m, bn), lambda j, s, sg, sm, *_: (sm[s], j), **kw),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, k, bn), lambda j, s, sg, sm, *_: (sg[s], 0, j), **kw
+        ),
+    )
+    dw = pl.pallas_call(
+        partial(_tgmm_kernel, block_m),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((e, k, n_padded), jnp.float32),
+        interpret=interpret,
+    )(sg, sm, first_g, valid, start, end, lhs_p, dout_p)
+    dw = dw[:, :, :n]
+    # Empty groups are never visited — their (uninitialized) blocks must
+    # read as zero gradient.
+    return jnp.where((group_sizes > 0)[:, None, None], dw, 0.0)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _gmm_pallas(lhs, rhs, group_sizes, block_m, block_n, interpret):
+    return _gmm_fwd_impl(lhs, rhs, group_sizes, block_m, block_n, interpret)
+
+
+def _gmm_pallas_fwd(lhs, rhs, group_sizes, block_m, block_n, interpret):
+    out = _gmm_fwd_impl(lhs, rhs, group_sizes, block_m, block_n, interpret)
+    return out, (lhs, rhs, group_sizes)
+
+
+def _gmm_pallas_bwd(block_m, block_n, interpret, res, dout):
+    lhs, rhs, group_sizes = res
+    dout = dout.astype(jnp.float32)
+    # dx: same kernel, experts transposed ([E, N, K]).
+    dlhs = _gmm_fwd_impl(
+        dout, jnp.swapaxes(rhs, 1, 2).astype(jnp.float32), group_sizes,
+        block_m, block_n, interpret,
+    ).astype(lhs.dtype)
+    drhs = _tgmm_impl(
+        lhs.astype(jnp.float32), dout, group_sizes, rhs.shape[0],
+        block_m, block_n, interpret,
+    ).astype(rhs.dtype)
+    gs_ct = np.zeros(group_sizes.shape, jax.dtypes.float0)
+    return dlhs, drhs, gs_ct
+
+
+_gmm_pallas.defvjp(_gmm_pallas_fwd, _gmm_pallas_bwd)
+
+
+def grouped_matmul(
+    lhs,
+    rhs,
+    group_sizes,
+    *,
+    impl: str = "ragged",
+    precision=None,
+    block_m: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+):
+    """``out[r] = lhs[r] @ rhs[g(r)]`` where row ``r`` belongs to group
+    ``g(r)`` under the contiguous-group layout (``group_sizes[e]`` rows
+    per expert ``e``, in order; rows past ``sum(group_sizes)`` are
+    don't-care and come back unspecified).
+
+    lhs ``[M, K]``, rhs ``[E, K, N]``, group_sizes int ``[E]`` (traced —
+    dynamic values, static shapes) → ``[M, N]``. Differentiable in lhs
+    and rhs with both impls.
+    """
+    if lhs.ndim != 2 or rhs.ndim != 3 or lhs.shape[1] != rhs.shape[1]:
+        raise ValueError(
+            f"grouped_matmul shapes: lhs {lhs.shape}, rhs {rhs.shape}"
+        )
+    if group_sizes.shape != (rhs.shape[0],):
+        raise ValueError(
+            f"group_sizes {group_sizes.shape} != [num_groups {rhs.shape[0]}]"
+        )
+    if impl == "ragged":
+        return lax.ragged_dot(
+            lhs, rhs, group_sizes.astype(jnp.int32), precision=precision
+        )
+    if impl == "pallas":
+        return _gmm_pallas(
+            lhs, rhs, group_sizes, block_m, block_n, interpret
+        ).astype(lhs.dtype)
+    raise ValueError(f"unknown grouped_matmul impl {impl!r}")
