@@ -80,11 +80,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-groups", type=int, default=1,
                    help="token groups for MoE routing/capacity (GShard "
                         "dispatch-cost lever; 0 = auto ~1024 tokens/group)")
-    p.add_argument("--moe-dispatch", choices=("einsum", "scatter"),
+    p.add_argument("--moe-dispatch",
+                   choices=("einsum", "scatter", "dropless"),
                    default="scatter",
-                   help="token movement: GShard one-hot einsums, or "
+                   help="token movement: GShard one-hot einsums, "
                         "scatter-add/gather (round 5 — same routing and "
-                        "drop semantics)")
+                        "drop semantics), or dropless (no capacity — "
+                        "ragged grouped matmuls; rejects "
+                        "--moe-expert-parallel)")
+    p.add_argument("--moe-gmm-impl", choices=("ragged", "pallas"),
+                   default="ragged",
+                   help="grouped-matmul backend for --moe-dispatch "
+                        "dropless: XLA ragged_dot or the Pallas gmm "
+                        "kernel")
     p.add_argument("--moe-expert-parallel", action="store_true")
     # mesh
     p.add_argument("--data-parallel", type=int, default=1)
@@ -235,17 +243,16 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
     composes with tensor parallelism, RoPE, GQA, flash, remat, MoE
     expert parallelism, the optimizer/schedule registry, bfloat16,
     checkpoint/resume, and held-out eval; round 5 adds --zero1
-    (data-sharded AdamW moments chunked per (pipe, tensor) coordinate)
-    and --grad-clip-norm (spec-aware exact global norm). The remaining
-    rejections below are the features the pipeline schedules genuinely
-    cannot express."""
+    (data-sharded AdamW moments chunked per (pipe, tensor) coordinate),
+    --fsdp (params AND moments chunked — just-in-time all_gather in the
+    step) and --grad-clip-norm (spec-aware exact global norm). The
+    remaining rejections below are the features the pipeline schedules
+    genuinely cannot express."""
     import math
 
     # Flags the pipeline engine cannot express are rejected — a silently
     # dropped option would train a different configuration than asked.
     for flag, val, default, why in (
-        ("--fsdp", args.fsdp, False,
-         "chunk-sharded params live on the shard_map engine"),
         ("--generate", args.generate, 0,
          "decode runs on the shard_map engine (export params instead)"),
         ("--beam", args.beam, 0,
@@ -319,6 +326,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         moe_top_k=args.moe_top_k,
         moe_groups=args.moe_groups,
         moe_dispatch=args.moe_dispatch,
+        moe_gmm_impl=args.moe_gmm_impl,
         moe_expert_parallel=args.moe_expert_parallel,
         data_parallel=args.data_parallel,
         pipeline_parallel=args.pipeline_parallel,
@@ -342,6 +350,7 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         weight_decay=args.weight_decay,
         grad_clip_norm=args.grad_clip_norm,
         zero1=args.zero1,
+        fsdp=args.fsdp,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         halt_on_nonfinite=args.halt_on_nonfinite,
@@ -465,6 +474,7 @@ def main(argv: list[str] | None = None) -> int:
         moe_top_k=args.moe_top_k,
         moe_groups=args.moe_groups,
         moe_dispatch=args.moe_dispatch,
+        moe_gmm_impl=args.moe_gmm_impl,
         moe_expert_parallel=args.moe_expert_parallel,
         data_parallel=args.data_parallel,
         seq_parallel=args.seq_parallel,

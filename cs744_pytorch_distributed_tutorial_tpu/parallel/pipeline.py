@@ -833,7 +833,10 @@ class PipelineLMConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_groups: int = 1
-    moe_dispatch: str = "scatter"  # token movement: einsum | scatter
+    # token movement: einsum | scatter | dropless (no capacity — ragged
+    # grouped matmuls inside the stage FFNs; rejects expert parallelism)
+    moe_dispatch: str = "scatter"
+    moe_gmm_impl: str = "ragged"  # dropless backend: ragged | pallas
     moe_expert_parallel: bool = False
 
     data_parallel: int = 1
@@ -913,6 +916,20 @@ class PipelineLMConfig:
     # the replicated optimizer (tested); resume is mesh-elastic over
     # data_parallel like the LM engine's.
     zero1: bool = False
+
+    # ZeRO-3/FSDP for the pipeline engine (late round 5 — the
+    # multi-axis generalization the roadmap scoped out): params AND
+    # moments persist ONLY as flat chunks over the DATA axis, chunked
+    # per (pipe[, tensor]) coordinate ([dp, S(, T), chunk] layout —
+    # parallel/zero.py::FsdpAdam's N-axis shard/unshard). The step
+    # all_gathers each leaf's local view just-in-time (XLA frees the
+    # full weights after their last use), the schedules run on those
+    # LOCAL views unchanged, and the raw local grads reduce-scatter
+    # into mean-grad chunks (``apply_local_grads`` — same bytes per
+    # leaf as zero1's pair). Persistent per-device params+moments drop
+    # from 3x stage-params to 3x stage-params / data_parallel.
+    # Mutually exclusive with zero1; same restrictions otherwise.
+    fsdp: bool = False
 
     # Checkpoint/resume (Orbax, utils/checkpoint.py): fit()'s batch plan
     # is a pure function of the step index, so restarts resume exactly.
@@ -1098,6 +1115,13 @@ class PipelineLMTrainer:
                 f"moe_experts {cfg.moe_experts} not divisible by the data "
                 f"axis ({self.data_size}) for expert parallelism"
             )
+        if self.expert_parallel and cfg.moe_dispatch == "dropless":
+            raise ValueError(
+                "moe_dispatch='dropless' does not compose with "
+                "moe_expert_parallel: EP's all_to_all needs static "
+                "per-destination counts (capacity slots); use "
+                "moe_dispatch='scatter' for expert-parallel layouts"
+            )
         self._dtype = resolve_dtype(cfg.compute_dtype)
         interpret = interpret_kernels(self.mesh)
         has_tensor = TENSOR_AXIS in self.mesh.shape and self.tensor_size > 1
@@ -1118,6 +1142,7 @@ class PipelineLMTrainer:
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_num_groups=cfg.moe_groups,
             moe_dispatch=cfg.moe_dispatch,
+            moe_gmm_impl=cfg.moe_gmm_impl,
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             rope=cfg.use_rope,
@@ -1169,16 +1194,24 @@ class PipelineLMTrainer:
             "head": P(None, TENSOR_AXIS) if has_tensor else P(),
         }
         param_shapes = jax.eval_shape(self._init_host, 0)
-        if cfg.zero1:
-            # ZeRO-1 over the data axis, chunked per (pipe[, tensor])
+        if cfg.zero1 and cfg.fsdp:
+            raise ValueError("zero1 and fsdp are mutually exclusive")
+        if cfg.zero1 or cfg.fsdp:
+            # ZeRO over the data axis, chunked per (pipe[, tensor])
             # coordinate for the sharded block leaves (the generalized
-            # Zero1Adam shard_axes layout).
+            # Zero1Adam/FsdpAdam shard_axes layout). zero1 shards the
+            # moments; fsdp additionally persists the PARAMS as chunks
+            # and gathers local views just-in-time in the step.
+            which = "fsdp" if cfg.fsdp else "zero1"
             if self.expert_parallel:
                 raise ValueError(
-                    "zero1=True is incompatible with moe_expert_parallel "
+                    f"{which}=True is incompatible with moe_expert_parallel "
                     "(expert-sharded leaves are not data-replicated)"
                 )
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+                FsdpAdam,
+                FsdpLion,
+                FsdpSgdLM,
                 Zero1Adam,
                 Zero1Lion,
                 Zero1SgdLM,
@@ -1197,10 +1230,13 @@ class PipelineLMTrainer:
             # round-5 family; b2 defaults mirror make_optimizer's).
             try:
                 opt_cls, b2 = {
-                    "adamw": (Zero1Adam, 0.999),
-                    "lion": (Zero1Lion, 0.99),
-                    "sgd": (Zero1SgdLM, 0.0),
-                }[cfg.optimizer]
+                    ("zero1", "adamw"): (Zero1Adam, 0.999),
+                    ("zero1", "lion"): (Zero1Lion, 0.99),
+                    ("zero1", "sgd"): (Zero1SgdLM, 0.0),
+                    ("fsdp", "adamw"): (FsdpAdam, 0.999),
+                    ("fsdp", "lion"): (FsdpLion, 0.99),
+                    ("fsdp", "sgd"): (FsdpSgdLM, 0.0),
+                }[which, cfg.optimizer]
             except KeyError:
                 raise ValueError(
                     f"unknown optimizer {cfg.optimizer!r}; choose from "
@@ -1225,14 +1261,35 @@ class PipelineLMTrainer:
                 name: moment_specs for name in opt_cls.MOMENTS
             }
             self.opt_specs["count"] = P()
-            # Mesh-elastic resume: moment chunks re-chunk across
-            # data_parallel sizes; (pipe[, tensor]) coordinates are
-            # layout-pinned (parallel/zero.py::make_elastic_adapt).
+            # Mesh-elastic resume: moment chunks (and fsdp's param
+            # chunks) re-chunk across data_parallel sizes;
+            # (pipe[, tensor]) coordinates are layout-pinned
+            # (parallel/zero.py::make_elastic_adapt).
             self._zero_elastic_adapt = make_elastic_adapt(
-                chunk_local_sizes(param_shapes, self.param_specs, shard_axes)
+                chunk_local_sizes(param_shapes, self.param_specs, shard_axes),
+                prefixes=("opt_state/mu/", "opt_state/nu/")
+                + (("params/",) if cfg.fsdp else ()),
             )
+            # The original (pipe/tensor-aware) specs drive the chunk
+            # layout and the in-step drift guards; under fsdp the
+            # STORED params switch to the chunked layout.
+            self._orig_param_specs = self.param_specs
+            if cfg.fsdp:
+                from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+                    local_chunk_shapes,
+                )
+
+                # Full shapes template unshard_host (export/oracle);
+                # LOCAL shapes (every present shard-axis dim divided)
+                # template the in-shard_map gather.
+                self._param_shapes = param_shapes
+                self._local_param_shapes = local_chunk_shapes(
+                    param_shapes, self._orig_param_specs, shard_axes
+                )
+                self.param_specs = moment_specs
         else:
             self._zero1_opt = None
+            self._orig_param_specs = self.param_specs
             if cfg.grad_clip_norm is not None:
                 # Spec-aware global-norm clip: pipe-/tensor-sharded
                 # block grads are per-stage locals, so the plain optax
@@ -1304,10 +1361,16 @@ class PipelineLMTrainer:
         params = self._init_host(self.cfg.seed if seed is None else seed)
         params["blocks"] = self.blocks_to_storage(params["blocks"])
         opt_state = (
-            self._zero1_opt.init(params, self.param_specs)
+            self._zero1_opt.init(params, self._orig_param_specs)
             if self._zero1_opt is not None
             else self.tx.init(params)
         )
+        if self.cfg.fsdp:
+            # Params persist as flat chunks ([dp, S(, T), chunk]); the
+            # step gathers local views just-in-time.
+            params = self._zero1_opt.shard_params(
+                params, self._orig_param_specs
+            )
         put = lambda tree, specs: jax.tree.map(
             lambda x, s: host_to_global(x, NamedSharding(self.mesh, s)),
             tree, specs,
@@ -1435,9 +1498,20 @@ class PipelineLMTrainer:
         tx = self.tx
         zero1_opt = self._zero1_opt
         param_specs, opt_specs = self.param_specs, self.opt_specs
+        orig_param_specs = self._orig_param_specs
+        fsdp = cfg.fsdp
+        local_shapes = getattr(self, "_local_param_shapes", None)
         has_tensor = self._has_tensor
         has_seq = self.seq_size > 1
         stage_fn = self._stage_fn()
+
+        def materialize(params):
+            """FSDP unshard at the shard_map boundary: one all_gather
+            per leaf reconstructs this device's LOCAL (pipe/tensor
+            coordinate) param view; a no-op otherwise."""
+            if not fsdp:
+                return params
+            return zero1_opt.gather_params(params, local_shapes)
 
         num_chunks = self.num_chunks
         dropout = cfg.dropout_rate
@@ -1626,11 +1700,20 @@ class PipelineLMTrainer:
                     )
             else:
                 drop_base = None
-            loss, grads = inner(params, tokens, targets, drop_base)
+            loss, grads = inner(materialize(params), tokens, targets, drop_base)
             loss = lax.pmean(loss, DATA_AXIS)
             if has_seq:
                 loss = lax.pmean(loss, SEQ_AXIS)
-            if zero1_opt is not None:
+            if fsdp:
+                # FSDP: grads are w.r.t. the gathered LOCAL views (the
+                # schedules' hand-built backward can't emit pre-scattered
+                # cotangents); apply_local_grads reduce-scatters each
+                # into this device's mean-grad chunk and updates the
+                # stored param/moment shards — params stay chunked.
+                params, opt_state = zero1_opt.apply_local_grads(
+                    params, opt_state, grads, orig_param_specs
+                )
+            elif zero1_opt is not None:
                 # ZeRO-1 consumes the RAW local grads (the LM engine's
                 # contract): its per-leaf psum_scatter IS the data-axis
                 # reduction, the seq pmean runs on the chunk, and the
@@ -1685,7 +1768,7 @@ class PipelineLMTrainer:
         )
         self.forward_fn = jax.jit(
             jax.shard_map(
-                forward,
+                lambda params, tokens: forward(materialize(params), tokens),
                 mesh=self.mesh,
                 in_specs=(param_specs, batch_spec),
                 out_specs=logits_spec,
@@ -1694,7 +1777,7 @@ class PipelineLMTrainer:
         )
 
         def local_eval(params, tokens, targets):
-            logits = forward(params, tokens)
+            logits = forward(materialize(params), tokens)
             loss = lax.pmean(self._ce(logits, targets), DATA_AXIS)
             if has_seq:
                 loss = lax.pmean(loss, SEQ_AXIS)
@@ -1723,6 +1806,18 @@ class PipelineLMTrainer:
             host_to_global(tokens[:, :-1], sharding),
             host_to_global(tokens[:, 1:], sharding),
         )
+
+    def host_params(self, params):
+        """Params as full host arrays at the STORAGE layout (blocks in
+        storage order — ``blocks_to_logical`` undoes interleaving):
+        fsdp chunks unshard host-side (the global ``[dp, ...]`` arrays
+        already hold every chunk — no collectives); other layouts just
+        fetch. The export/oracle entry point for chunked-param runs."""
+        if self.cfg.fsdp:
+            return self._zero1_opt.unshard_host(
+                params, self._param_shapes, self._orig_param_specs
+            )
+        return jax.device_get(params)
 
     def reference_forward(self, params_global, tokens):
         """Unpipelined single-device forward on the SAME global params —

@@ -104,6 +104,23 @@ def rechunk_elastic(saved, like, local_size: int):
     return out.reshape(like.shape)
 
 
+def local_chunk_shapes(param_shapes, specs, shard_axes: dict):
+    """Per-device LOCAL shapes: each leaf's global shape with every
+    dim a present ``shard_axes`` axis names divided by that axis's
+    size. The template for ``FsdpAdam.gather_params``'s unshard (both
+    LM and pipeline engines precompute this tree)."""
+
+    def leaf(sh, spec):
+        dims = list(sh.shape)
+        for a, size in shard_axes.items():
+            k = spec_dim(spec, a)
+            if k is not None:
+                dims[k] //= size
+        return jax.ShapeDtypeStruct(tuple(dims), sh.dtype)
+
+    return jax.tree.map(leaf, param_shapes, specs)
+
+
 def chunk_local_sizes(param_shapes, specs, shard_axes: dict) -> dict:
     """Path-keyed UNPADDED local flat sizes for the elastic re-chunk:
     each param leaf's element count divided by the sizes of the
@@ -578,36 +595,27 @@ class FsdpAdam(Zero1Adam):
     ``[axis_size, chunk]`` shards; in-shard_map unshard needs the
     original shape tree).
 
-    Tensor-parallel composition (round 5): tensor-sharded leaves chunk
-    each LOCAL tensor shard independently — host layout
-    ``[axis_size, tensor_size, chunk]`` (sharded over data AND tensor),
-    the in-shard_map unshard reconstructs the LOCAL tensor shard (so
-    ``gather_params`` takes the LOCAL shape tree), and ``unshard_host``
-    reassembles the global leaf by concatenating the per-tensor-shard
-    pieces along the sharded dim. At most ONE model-shard axis
-    (``Zero1Adam``'s generalized dict supports several for the
-    pipeline engine's moments, but fsdp's host shard/unshard pair is
-    single-axis).
+    Model-shard composition (round 5, generalized to N axes late round
+    5): model-sharded leaves chunk each LOCAL shard independently —
+    host layout ``[axis_size, *present_axis_sizes, chunk]`` (sharded
+    over data AND every present model axis; e.g. ``[dp, T, chunk]``
+    for a tensor-sharded LM leaf, ``[dp, S, T, chunk]`` for a
+    pipe-AND-tensor-sharded pipeline block). The in-shard_map unshard
+    reconstructs the LOCAL model shard (so ``gather_params`` takes the
+    LOCAL shape tree), and ``unshard_host`` reassembles the global
+    leaf by concatenating the per-coordinate pieces along each sharded
+    dim, innermost axis first.
     """
-
-    def _model_axis(self) -> tuple:
-        """(axis_name, size) of the single model-shard axis (None, 1 if
-        none configured)."""
-        if len(self.shard_axes) > 1:
-            raise ValueError(
-                "FsdpAdam supports at most one model-shard axis, got "
-                f"{tuple(self.shard_axes)}"
-            )
-        return next(iter(self.shard_axes.items()), (None, 1))
 
     def shard_params(self, params, specs=None):
         """GLOBAL param tree -> flat chunked shards: ``[axis_size,
-        chunk]`` per replicated leaf, ``[axis_size, tensor_size, chunk]``
-        per tensor-sharded leaf (each tensor shard's flat view chunked
-        over the data axis independently)."""
+        chunk]`` per replicated leaf, ``[axis_size, *present_sizes,
+        chunk]`` per model-sharded leaf (each model-coordinate shard's
+        flat view chunked over the data axis independently; nested
+        splits in ``shard_axes`` order, so two axes on the SAME dim —
+        a ``P(('pipe', 'tensor'), ...)`` leaf — compose as pipe-major)."""
         if specs is None:
             specs = _replicated_specs(params)
-        axis, size = self._model_axis()
 
         def rows(x):
             # flat local view -> zero-padded [axis_size, chunk]
@@ -617,13 +625,19 @@ class FsdpAdam(Zero1Adam):
             ).reshape(self.axis_size, chunk)
 
         def leaf(p, spec):
-            k = spec_dim(spec, axis)
-            if k is None:
-                return rows(p)
-            return jnp.stack(
-                [rows(sh) for sh in jnp.split(p, size, axis=k)],
-                axis=1,
-            )
+            def rec(x, axes):
+                if not axes:
+                    return rows(x)
+                a, rest = axes[0], axes[1:]
+                parts = [
+                    rec(sh, rest)
+                    for sh in jnp.split(
+                        x, self.shard_axes[a], axis=spec_dim(spec, a)
+                    )
+                ]
+                return jnp.stack(parts, axis=1)
+
+            return rec(p, self._present(spec))
 
         return jax.tree.map(leaf, params, specs)
 
@@ -638,31 +652,36 @@ class FsdpAdam(Zero1Adam):
     def unshard_host(self, shards, shape_tree, specs=None):
         """Host-side inverse of ``shard_params`` for export/decode: the
         global chunked arrays already hold every chunk — reshape/slice
-        (+ concat over tensor shards), no collectives."""
+        (+ concat over each model-shard axis, pipe-major like the
+        shard), no collectives."""
         import numpy as np
 
         if specs is None:
             specs = _replicated_specs(shape_tree)
-        axis, size = self._model_axis()
 
         def leaf(sh, sds, spec):
             flat = np.asarray(jax.device_get(sh))
             dtype = np.asarray([], sds.dtype).dtype
-            k = spec_dim(spec, axis)
-            if k is None:
-                return (
-                    flat.reshape(-1)[: math.prod(sds.shape)]
-                    .reshape(sds.shape)
-                    .astype(dtype)
-                )
-            local_shape = list(sds.shape)
-            local_shape[k] //= size
-            local_size = math.prod(local_shape)
-            parts = [
-                flat[:, t, :].reshape(-1)[:local_size].reshape(local_shape)
-                for t in range(size)
-            ]
-            return np.concatenate(parts, axis=k).astype(dtype)
+
+            def rec(arr, axes, shape):
+                if not axes:
+                    return (
+                        arr.reshape(-1)[: math.prod(shape)]
+                        .reshape(shape)
+                    )
+                a, rest = axes[0], axes[1:]
+                k = spec_dim(spec, a)
+                sub = list(shape)
+                sub[k] //= self.shard_axes[a]
+                parts = [
+                    rec(arr[:, i], rest, sub)
+                    for i in range(self.shard_axes[a])
+                ]
+                return np.concatenate(parts, axis=k)
+
+            return rec(flat, self._present(spec), list(sds.shape)).astype(
+                dtype
+            )
 
         return jax.tree.map(leaf, shards, shape_tree, specs)
 
@@ -680,15 +699,11 @@ class FsdpAdam(Zero1Adam):
                 g_mine = lax.pmean(g_mine, a)
         return g_mine
 
-    def apply(self, param_shards, state, grad_chunks, specs=None):
-        """One FSDP step from CHUNKED grad sums: mean-ify (and
-        optionally clip, ``_clip_chunks``) the chunks, then run the
-        shared chunk rule on the local shards."""
-        count, lr, c1, c2 = self._step_scalars(state)
-        if specs is None:
-            specs = _replicated_specs(param_shards)
-        chunks = jax.tree.map(self._mean_chunk, grad_chunks, specs)
-        chunks = self._clip_chunks(chunks, specs)
+    def _update_shards(self, param_shards, state, chunks, count, lr, c1, c2):
+        """The shared FSDP update: run the chunk rule on the stored
+        local shards against the prepared mean-grad ``chunks``. No
+        delta all_gather — params stay sharded (the next step's
+        ``gather_params`` re-materializes them)."""
 
         def leaf(psh, g_mine, *moms):
             chunk = psh.shape[-1]
@@ -710,6 +725,42 @@ class FsdpAdam(Zero1Adam):
         for i, name in enumerate(self.MOMENTS):
             new_state[name] = pick(1 + i)
         return pick(0), new_state
+
+    def apply(self, param_shards, state, grad_chunks, specs=None):
+        """One FSDP step from CHUNKED grad sums: mean-ify (and
+        optionally clip, ``_clip_chunks``) the chunks, then run the
+        shared chunk rule on the local shards."""
+        count, lr, c1, c2 = self._step_scalars(state)
+        if specs is None:
+            specs = _replicated_specs(param_shards)
+        chunks = jax.tree.map(self._mean_chunk, grad_chunks, specs)
+        chunks = self._clip_chunks(chunks, specs)
+        return self._update_shards(
+            param_shards, state, chunks, count, lr, c1, c2
+        )
+
+    def apply_local_grads(self, param_shards, state, grads, specs=None):
+        """One FSDP step from FULL local grad leaves. Engines whose
+        backward is hand-scheduled (the pipeline schedules) produce
+        gradients w.r.t. the gathered LOCAL params rather than the
+        pre-scattered cotangents differentiating through
+        ``gather_params`` yields — ``Zero1Adam``'s psum_scatter
+        mean-chunk turns each such leaf into this device's mean-grad
+        chunk (identical bytes to the AD-transposed route: one
+        reduce-scatter per leaf), then the shared chunk rule updates
+        the stored shards."""
+        count, lr, c1, c2 = self._step_scalars(state)
+        if specs is None:
+            specs = _replicated_specs(param_shards)
+        chunks = jax.tree.map(
+            lambda g, spec: Zero1Adam._mean_chunk(self, g, spec),
+            grads,
+            specs,
+        )
+        chunks = self._clip_chunks(chunks, specs)
+        return self._update_shards(
+            param_shards, state, chunks, count, lr, c1, c2
+        )
 
 
 class Zero1Lion(Zero1Adam):

@@ -120,10 +120,19 @@ class LMConfig:
     # global group). Part of routing semantics: capacity is per group.
     moe_groups: int = 1
     # Token movement (models/moe.py::MoEFFN.dispatch_impl): "einsum"
-    # (GShard one-hot contractions) or "scatter" (scatter-add/gather —
-    # round 5, targeting the measured dispatch tax). Routing and drop
-    # semantics are identical; trajectories match to float tolerance.
+    # (GShard one-hot contractions), "scatter" (scatter-add/gather —
+    # round 5, targeting the measured dispatch tax), or "dropless"
+    # (late round 5 — NO capacity: tokens argsort by expert and the
+    # expert FFN runs as ragged grouped matmuls, ops/gmm.py; every
+    # routed token computes, capacity/groups are ignored, and
+    # moe_expert_parallel is rejected — EP's all_to_all needs the
+    # static per-destination counts capacity slots provide).
+    # einsum/scatter share routing and drop semantics exactly;
+    # trajectories match to float tolerance.
     moe_dispatch: str = "scatter"
+    # Grouped-matmul backend for moe_dispatch="dropless": "ragged"
+    # (lax.ragged_dot) or "pallas" (the megablox-style TPU kernel).
+    moe_gmm_impl: str = "ragged"
     moe_expert_parallel: bool = False
     moe_aux_coef: float = 0.01
 
@@ -333,6 +342,13 @@ class LMTrainer:
                 f"moe_experts {cfg.moe_experts} not divisible by the data axis "
                 f"({self.data_size}) for expert parallelism"
             )
+        if self.expert_parallel and cfg.moe_dispatch == "dropless":
+            raise ValueError(
+                "moe_dispatch='dropless' does not compose with "
+                "moe_expert_parallel: EP's all_to_all needs static "
+                "per-destination counts (capacity slots); use "
+                "moe_dispatch='scatter' for expert-parallel layouts"
+            )
         dtype = resolve_dtype(cfg.compute_dtype)
         flash_interpret = interpret_kernels(self.mesh)
         self._flash_interpret = flash_interpret
@@ -355,6 +371,7 @@ class LMTrainer:
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_num_groups=cfg.moe_groups,
             moe_dispatch=cfg.moe_dispatch,
+            moe_gmm_impl=cfg.moe_gmm_impl,
             expert_axis=DATA_AXIS if self.expert_parallel else None,
             expert_axis_size=self.data_size if self.expert_parallel else 1,
             remat=cfg.remat,
@@ -496,19 +513,17 @@ class LMTrainer:
                 # Params live as flat chunked shards too: the original
                 # full shapes/dtypes are the unshard template, and the
                 # LOCAL shapes (tensor dim divided) template the
-                # in-shard_map gather.
+                # in-shard_map gather (shared rule:
+                # parallel/zero.py::local_chunk_shapes).
+                from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+                    local_chunk_shapes,
+                )
+
                 self._param_shapes = param_shapes
-
-                def local_shape(sh, spec):
-                    k = spec_dim(spec, TENSOR_AXIS)
-                    if k is None or self.tensor_size == 1:
-                        return sh
-                    dims = list(sh.shape)
-                    dims[k] //= self.tensor_size
-                    return jax.ShapeDtypeStruct(tuple(dims), sh.dtype)
-
-                self._local_param_shapes = jax.tree.map(
-                    local_shape, param_shapes, self._orig_param_specs
+                self._local_param_shapes = local_chunk_shapes(
+                    param_shapes,
+                    self._orig_param_specs,
+                    {TENSOR_AXIS: self.tensor_size},
                 )
                 self.param_specs = moment_specs
         else:
