@@ -252,6 +252,58 @@ def bench_moe(batch: int = 32, seq: int = 512) -> list[dict]:
     return rows
 
 
+def bench_moe_expert_sweep(batch: int = 32, seq: int = 512) -> list[dict]:
+    """Where dropless pays: high expert counts. Capacity-slot compute
+    scales with E*C = k*cf*N regardless of E, but the DROP RATE at
+    fixed cf grows with routing imbalance, which grows with E (an
+    untrained router over E=32 experts is far from uniform per group);
+    covering the skew with cf costs proportional compute. Dropless
+    computes exactly k*N rows at any E and any skew — this sweep
+    measures both sides of that trade at E=8/32 with top-2."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    base = dict(
+        vocab_size=50304, num_layers=6, num_heads=8, d_model=512,
+        d_ff=1024, max_seq_len=seq, seq_len=seq, global_batch_size=batch,
+        attention_impl="flash", compute_dtype="bfloat16", use_rope=True,
+        moe_top_k=2,
+    )
+    rows = []
+    for name, kw in (
+        ("e8_scatter_cf125", dict(moe_experts=8, moe_dispatch="scatter")),
+        ("e8_dropless", dict(moe_experts=8, moe_dispatch="dropless")),
+        ("e32_scatter_cf125", dict(moe_experts=32, moe_dispatch="scatter")),
+        # cf covering the observed e32 init drop rate costs slots.
+        ("e32_scatter_cf2", dict(moe_experts=32, moe_dispatch="scatter",
+                                 moe_capacity_factor=2.0)),
+        ("e32_dropless", dict(moe_experts=32, moe_dispatch="dropless")),
+    ):
+        cfg = LMConfig(**base, **kw)
+        tr = LMTrainer(cfg, mesh=make_mesh({"data": 1, "seq": 1}))
+        params, opt = tr.init()
+        x, y = tr.shard_batch(synthetic_tokens(batch, seq, 50304, seed=0))
+        params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])
+        for _ in range(WARMUP):
+            params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, opt, m = tr.train_step(params, opt, x, y)
+        float(m["loss"])
+        dt = (time.perf_counter() - t0) / STEPS
+        rows.append({
+            "metric": f"moe_expert_sweep_{name}",
+            "ms_per_step": round(dt * 1e3, 2),
+            "tokens_per_sec": round(batch * seq / dt),
+            "moe_drop": round(float(m["moe_drop"]), 4),
+            "config": f"6L/512d/1024ff/top2/b{batch}/T{seq}",
+        })
+    return rows
+
+
 def moe_training_trajectory() -> dict:
     """A short real fit() so drop-rate/aux-loss are shown as measured
     TRAJECTORIES (the test pins the plumbing; this pins the numbers)."""
@@ -293,6 +345,9 @@ def main() -> None:
             print(json.dumps(vit_descends(model)), flush=True)
     if "moe" in which:
         for row in bench_moe():
+            print(json.dumps(row), flush=True)
+    if "moe_sweep" in which:
+        for row in bench_moe_expert_sweep():
             print(json.dumps(row), flush=True)
     if "moe_fit" in which:
         print(json.dumps(moe_training_trajectory()), flush=True)

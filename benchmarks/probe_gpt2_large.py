@@ -34,8 +34,13 @@ Findings:
   8192 tokens/step as the T=1024/b8 row) lands at 0.5006 vs 0.510 —
   the flash path's S-scaling costs ~2% MFU, and the long-context
   regime keeps the 1024d efficiency. The b8/T2048 point that would
-  test for a 0.52+ peak is COMPILE-WALLED (below), so 0.5006 is the
-  measured long-context ceiling here, not the model's.
+  test for a 0.52+ peak is COMPILE-WALLED (below); the late-round-5
+  session filled the gap from the compiling side: b5 = 0.4847,
+  b6 = 0.4678 — MFU DEGRADES monotonically past b4 (T=2048 remat-off
+  activations push the working set into a worse HBM regime well
+  before the wall), so **b4/0.5006 is a measured local optimum**,
+  not a truncated curve, and the 0.52+ hope is dead on this chip
+  regardless of the compile helper.
 - **The compile-helper wall boundary is now pinned from both sides**:
   medium-T2048 compiles at b4 and walls at b8 (= the b16/T1024
   footprint that walled round 4); large compiles at scan+dots b2 and

@@ -325,3 +325,40 @@ def test_pipeline_fsdp_rejections():
                  moe_experts=2, moe_expert_parallel=True),
             mesh=_mesh(2, 2),
         )
+
+
+def test_pipeline_zero_interleaved_schedule():
+    """The ZeRO machinery is schedule-agnostic — it chunks the STORAGE
+    layout, which the interleaved schedule permutes but does not
+    reshape. zero1 AND fsdp on the interleaved (V=2) schedule match the
+    replicated interleaved trajectory."""
+    mesh = _mesh(2, 2)
+    kw = dict(
+        data_parallel=2, pipeline_parallel=2, schedule="interleaved",
+        num_virtual_stages=2, num_microbatches=2,
+    )
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, _, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    _, _, _, fs = _run(_cfg(**kw, fsdp=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+    np.testing.assert_allclose(base, fs, rtol=2e-5)
+
+
+def test_pipeline_dropless_moe_in_stages():
+    """Dropless MoE inside pipeline stages (the ragged grouped matmuls
+    trace under the scanned stage body): matches the uncapped scatter
+    path — same routing, same gates, nothing drops — and rejects EP."""
+    mesh = _mesh(2, 2)
+    kw = dict(
+        data_parallel=2, pipeline_parallel=2, moe_experts=4,
+        moe_capacity_factor=4.0,  # uncapped for the scatter oracle
+    )
+    _, _, _, cap = _run(_cfg(**kw, moe_dispatch="scatter"), mesh, steps=3)
+    _, _, _, dr = _run(_cfg(**kw, moe_dispatch="dropless"), mesh, steps=3)
+    np.testing.assert_allclose(cap, dr, rtol=2e-5)
+    with pytest.raises(ValueError, match="dropless"):
+        PipelineLMTrainer(
+            _cfg(data_parallel=2, pipeline_parallel=2, moe_experts=2,
+                 moe_expert_parallel=True, moe_dispatch="dropless"),
+            mesh=_mesh(2, 2),
+        )
