@@ -36,8 +36,17 @@ Batch scaling, round-4 re-measurement (the round-3 "b16 no better"
 was a dots-only artifact):
   flash + remat=OFF + b16  144.9 ms/step  113.0k tok/s  MFU 0.490  <- headline
                            (first probe same day: 110.9k / 0.481)
+  flash + remat=off + b20  192.9 ms/step  106.2k tok/s  MFU 0.461  (late r5)
   flash + remat=off + b24  239.7 ms/step  102.5k tok/s  MFU 0.445
+                           (late-r5 re-measure: 101.4k / 0.440)
   flash + remat=dots + b16  (round 3)      94.5k tok/s  MFU 0.41
+The late-round-5 b20 point pins the shape: throughput turns over
+MONOTONICALLY past b16 (113.0 -> 106.2 -> 101.4k), the same
+pre-compile-wall degradation medium-T2048 shows past b4 — the b16
+headline is a measured local optimum, not a wall-truncated curve.
+Remat does NOT rescue it (b24-dots 94.4k / 0.410 < b24-off), so the
+turnover is not activation capacity; it tracks the matmul/layout
+regime at those batch shapes.
 Batch 32 fails the tunnel's remote compile helper (HTTP 500) in EVERY
 variant tried round 4 — unrolled/scan_layers x dots/off x fused_xent
 on/off. scan_layers shrinks the traced program by 12x and fused_xent

@@ -173,6 +173,22 @@ def vit_descends(model: str = "vit_tiny") -> dict:
     }
 
 
+def _timed_lm_steps(tr, params, opt, x, y):
+    """Shared LM timing protocol: compile step, WARMUP steps, then
+    STEPS timed (each phase fenced by a loss fetch). Returns
+    (seconds/step, last metrics)."""
+    params, opt, m = tr.train_step(params, opt, x, y)
+    float(m["loss"])
+    for _ in range(WARMUP):
+        params, opt, m = tr.train_step(params, opt, x, y)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        params, opt, m = tr.train_step(params, opt, x, y)
+    float(m["loss"])
+    return (time.perf_counter() - t0) / STEPS, m
+
+
 def bench_moe(batch: int = 32, seq: int = 512) -> list[dict]:
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
@@ -229,16 +245,7 @@ def bench_moe(batch: int = 32, seq: int = 512) -> list[dict]:
         tr = LMTrainer(cfg, mesh=make_mesh({"data": 1, "seq": 1}))
         params, opt = tr.init()
         x, y = tr.shard_batch(synthetic_tokens(batch, seq, 50304, seed=0))
-        params, opt, m = tr.train_step(params, opt, x, y)
-        float(m["loss"])
-        for _ in range(WARMUP):
-            params, opt, m = tr.train_step(params, opt, x, y)
-        float(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            params, opt, m = tr.train_step(params, opt, x, y)
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / STEPS
+        dt, m = _timed_lm_steps(tr, params, opt, x, y)
         row = {
             "metric": f"moe_vs_dense_{name}",
             "ms_per_step": round(dt * 1e3, 2),
@@ -284,16 +291,7 @@ def bench_moe_expert_sweep(batch: int = 32, seq: int = 512) -> list[dict]:
         tr = LMTrainer(cfg, mesh=make_mesh({"data": 1, "seq": 1}))
         params, opt = tr.init()
         x, y = tr.shard_batch(synthetic_tokens(batch, seq, 50304, seed=0))
-        params, opt, m = tr.train_step(params, opt, x, y)
-        float(m["loss"])
-        for _ in range(WARMUP):
-            params, opt, m = tr.train_step(params, opt, x, y)
-        float(m["loss"])
-        t0 = time.perf_counter()
-        for _ in range(STEPS):
-            params, opt, m = tr.train_step(params, opt, x, y)
-        float(m["loss"])
-        dt = (time.perf_counter() - t0) / STEPS
+        dt, m = _timed_lm_steps(tr, params, opt, x, y)
         rows.append({
             "metric": f"moe_expert_sweep_{name}",
             "ms_per_step": round(dt * 1e3, 2),

@@ -192,3 +192,48 @@ def test_generation_with_bfloat16_and_remat_variants():
         params, prompt, jax.random.key(2)
     )
     assert out.shape == (2, 4)
+
+
+@pytest.mark.parametrize("dispatch", ["scatter", "dropless"])
+def test_moe_decode_logits_match_full_forward(dispatch):
+    """The routed-FFN decode path: cached prefill+decode on a MoE LM
+    must reproduce the full forward's logits. At decode the token
+    routes ALONE (N=1, so top-k experts each see one row) — parity
+    with the batched forward requires either capacity high enough that
+    the forward dropped nothing (scatter, cf=4) or the dropless path,
+    where nothing can drop by construction. Routing is data-dependent,
+    so this also pins that the ragged/slot machinery traces at N=1."""
+    model = TransformerLM(
+        vocab_size=VOCAB, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=32, attention_impl="dense", num_experts=4,
+        moe_top_k=2, moe_capacity_factor=4.0, moe_dispatch=dispatch,
+    )
+    toks0 = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), toks0)["params"]
+    tokens = jax.random.randint(jax.random.key(1), (2, 10), 0, VOCAB)
+    full_logits = model.apply({"params": params}, tokens)
+
+    t0 = 4
+    prefill_logits, variables = model.apply(
+        {"params": params}, tokens[:, :t0], mode="prefill", mutable=["cache"]
+    )
+    np.testing.assert_allclose(
+        prefill_logits, full_logits[:, :t0], rtol=1e-5, atol=1e-5
+    )
+    cache = variables["cache"]
+    for pos in range(t0, tokens.shape[1]):
+        step_logits, mutated = model.apply(
+            {"params": params, "cache": cache},
+            tokens[:, pos : pos + 1],
+            mode="decode",
+            decode_pos=jnp.asarray(pos, jnp.int32),
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        np.testing.assert_allclose(
+            step_logits[:, 0], full_logits[:, pos], rtol=1e-5, atol=1e-5
+        )
+    # And the jitted generator loop runs end-to-end on the MoE model.
+    gen = make_generator(model, max_new_tokens=4, temperature=0.0)
+    out = gen(params, tokens[:, :t0], jax.random.key(2))
+    assert out.shape == (2, 4)
