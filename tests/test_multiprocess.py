@@ -105,7 +105,7 @@ print(f"rank {{rank}} loader ok {{totals}}")
 """
 
 
-def _run_pair(script_template, tmp_path, repo, marker):
+def _run_pair(script_template, tmp_path, repo, marker, extra_args=()):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -118,7 +118,7 @@ def _run_pair(script_template, tmp_path, repo, marker):
     }
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", script, str(rank)],
+            [sys.executable, "-c", script, str(rank), *map(str, extra_args)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=str(tmp_path),
         )
@@ -444,3 +444,91 @@ def test_ring_attention_across_two_processes(tmp_path):
 
     got = ast.literal_eval(loss_lines[0].split("losses=")[1])
     np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+_ZERO_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {repo!r})
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import initialize
+from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+rank = int(sys.argv[1])
+mode = sys.argv[2]  # "zero1" | "fsdp"
+assert mode in ("zero1", "fsdp"), mode  # typo'd mode would pass trivially
+initialize({coord!r}, 2, rank)
+mesh = make_mesh({{"data": 2, "seq": 1}}, devices=jax.devices())
+cfg = LMConfig(
+    vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+    max_seq_len=64, attention_impl="dense", data_parallel=2,
+    seq_parallel=1, global_batch_size=4, seq_len=16, use_rope=True,
+    seed=5, zero1=(mode == "zero1"), fsdp=(mode == "fsdp"),
+)
+tr = LMTrainer(cfg, mesh=mesh)
+params, opt = tr.init()
+tokens = synthetic_tokens(16, cfg.seq_len, cfg.vocab_size, seed=11)
+losses = []
+for s in range(3):
+    x, y = tr.shard_batch(tokens[s * 4 : s * 4 + 4])
+    params, opt, m = tr.train_step(params, opt, x, y)
+    losses.append(round(float(m["loss"]), 6))
+print(f"rank {{rank}} zerolm ok losses={{losses}}")
+"""
+
+
+@pytest.mark.parametrize("mode", ["zero1", "fsdp"])
+def test_zero_sharded_optimizer_across_two_processes(mode, tmp_path):
+    """ZeRO's collective pair crossing a REAL process boundary: with
+    dp=2 spanning two single-device processes, every per-leaf
+    psum_scatter (mean-grad chunking) and all_gather (delta/param
+    unshard) rides the inter-process transport — the fourth kind of
+    2-real-process evidence (after DP metrics, pipeline hops, ring
+    attention). Both ranks observe identical losses, and the
+    trajectory matches the REPLICATED-optimizer single-process oracle
+    on a 2-virtual-device mesh (the ZeRO identity, now over the real
+    transport)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _run_pair(_ZERO_WORKER, tmp_path, repo, "zerolm ok",
+                     extra_args=[mode])
+    loss_lines = [
+        next(l for l in out.splitlines() if "losses=" in l) for out in outs
+    ]
+    assert loss_lines[0].split("losses=")[1] == loss_lines[1].split(
+        "losses="
+    )[1], loss_lines
+
+    import ast
+
+    import jax
+    import numpy as np
+
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import (
+        LMConfig,
+        LMTrainer,
+    )
+
+    cfg = LMConfig(
+        vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=64, attention_impl="dense", data_parallel=2,
+        seq_parallel=1, global_batch_size=4, seq_len=16, use_rope=True,
+        seed=5,
+    )
+    mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
+    tr = LMTrainer(cfg, mesh=mesh)
+    params, opt = tr.init()
+    tokens = synthetic_tokens(16, cfg.seq_len, cfg.vocab_size, seed=11)
+    oracle = []
+    for s in range(3):
+        x, y = tr.shard_batch(tokens[s * 4 : s * 4 + 4])
+        params, opt, m = tr.train_step(params, opt, x, y)
+        oracle.append(float(m["loss"]))
+    got = ast.literal_eval(loss_lines[0].split("losses=")[1])
+    np.testing.assert_allclose(got, oracle, rtol=2e-5)
