@@ -1202,12 +1202,12 @@ class PipelineLMTrainer:
             # Zero1Adam/FsdpAdam shard_axes layout). zero1 shards the
             # moments; fsdp additionally persists the PARAMS as chunks
             # and gathers local views just-in-time in the step.
+            # Expert-parallel leaves (spec naming DATA) keep
+            # NATURAL-shaped local state — EP already divides their
+            # memory over the data axis, and the optimizer's
+            # _expert_mean reproduces sync_grad's EP scaling (late
+            # round 5; was rejected).
             which = "fsdp" if cfg.fsdp else "zero1"
-            if self.expert_parallel:
-                raise ValueError(
-                    f"{which}=True is incompatible with moe_expert_parallel "
-                    "(expert-sharded leaves are not data-replicated)"
-                )
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpAdam,
                 FsdpLion,
@@ -1252,8 +1252,10 @@ class PipelineLMTrainer:
                 clip_norm=cfg.grad_clip_norm,
             )
             moment_specs = jax.tree.map(
-                lambda _, spec: P(
-                    DATA_AXIS, *self._zero1_opt._present(spec)
+                lambda _, spec: (
+                    spec  # expert-parallel leaf: natural, like the param
+                    if self._zero1_opt._data_sharded(spec)
+                    else P(DATA_AXIS, *self._zero1_opt._present(spec))
                 ),
                 param_shapes, self.param_specs,
             )
@@ -1266,7 +1268,10 @@ class PipelineLMTrainer:
             # (pipe[, tensor]) coordinates are layout-pinned
             # (parallel/zero.py::make_elastic_adapt).
             self._zero_elastic_adapt = make_elastic_adapt(
-                chunk_local_sizes(param_shapes, self.param_specs, shard_axes),
+                chunk_local_sizes(
+                    param_shapes, self.param_specs, shard_axes,
+                    exclude_axis=DATA_AXIS,  # expert leaves re-shard
+                ),
                 prefixes=("opt_state/mu/", "opt_state/nu/")
                 + (("params/",) if cfg.fsdp else ()),
             )
@@ -1508,10 +1513,13 @@ class PipelineLMTrainer:
         def materialize(params):
             """FSDP unshard at the shard_map boundary: one all_gather
             per leaf reconstructs this device's LOCAL (pipe/tensor
-            coordinate) param view; a no-op otherwise."""
+            coordinate) param view (expert-parallel leaves pass
+            through — already local); a no-op otherwise."""
             if not fsdp:
                 return params
-            return zero1_opt.gather_params(params, local_shapes)
+            return zero1_opt.gather_params(
+                params, local_shapes, orig_param_specs
+            )
 
         num_chunks = self.num_chunks
         dropout = cfg.dropout_rate

@@ -121,11 +121,17 @@ def local_chunk_shapes(param_shapes, specs, shard_axes: dict):
     return jax.tree.map(leaf, param_shapes, specs)
 
 
-def chunk_local_sizes(param_shapes, specs, shard_axes: dict) -> dict:
+def chunk_local_sizes(
+    param_shapes, specs, shard_axes: dict, exclude_axis: str | None = None
+) -> dict:
     """Path-keyed UNPADDED local flat sizes for the elastic re-chunk:
     each param leaf's element count divided by the sizes of the
     ``shard_axes`` its PartitionSpec names (the per-coordinate shard
-    length the chunk layout was built from)."""
+    length the chunk layout was built from). Leaves whose spec names
+    ``exclude_axis`` (expert-parallel leaves, sharded over the data
+    axis itself) are OMITTED: their state is natural-shaped, not flat
+    chunks, and restores across dp sizes by plain re-sharding — the
+    adapt hook must fall through to the default for them."""
     from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
         _path_key,
     )
@@ -140,6 +146,7 @@ def chunk_local_sizes(param_shapes, specs, shard_axes: dict) -> dict:
             n for a, n in shard_axes.items() if spec_dim(spec, a) is not None
         )
         for (path, leaf), spec in zip(shape_leaves, spec_leaves)
+        if spec_dim(spec, exclude_axis) is None
     }
 
 
@@ -416,15 +423,28 @@ class Zero1Adam:
             a for a in self.shard_axes if spec_dim(spec, a) is not None
         )
 
+    def _data_sharded(self, spec) -> bool:
+        """True for leaves already sharded over the DATA axis itself —
+        expert-parallel MoE params (EP-over-DP). Their optimizer state
+        is partitioned by construction (each device owns only its
+        experts' full state), so ZeRO keeps it LOCAL: natural shapes,
+        no flat chunking, no psum_scatter/all_gather — the memory
+        division the chunk layout buys elsewhere already exists."""
+        return spec_dim(spec, self.axis_name) is not None
+
     def init(self, params, specs=None):
         """Host-side global moment zeros: ``[axis_size, chunk]`` per
         replicated leaf, ``[axis_size, *present_sizes, chunk]`` per
         model-sharded leaf (``specs`` = the param PartitionSpec tree;
-        chunk = ceil(LOCAL leaf size / axis_size))."""
+        chunk = ceil(LOCAL leaf size / axis_size)); expert-parallel
+        (data-sharded) leaves keep their NATURAL global shape — the
+        trainer shards their moments exactly like the params."""
         if specs is None:
             specs = _replicated_specs(params)
 
         def leaf(p, spec):
+            if self._data_sharded(spec):
+                return jnp.zeros(p.shape, jnp.float32)
             present = self._present(spec)
             sizes = tuple(self.shard_axes[a] for a in present)
             local = p.size // math.prod(sizes)
@@ -476,13 +496,32 @@ class Zero1Adam:
         )
         return [mu_n, nu_n], update
 
+    def _expert_mean(self, g, spec):
+        """Expert-parallel (data-sharded) leaf: the all_to_all transpose
+        already summed this device's expert grads over its whole data
+        row (``train/lm.py::sync_grad``'s EP rule), so the remaining
+        job is the seq-replica sum and the 1 / (data * seq) of the
+        global-mean loss, plus the drift-guard pmean over shard axes
+        the leaf doesn't span. No chunking — the state is local."""
+        g_mine = g.astype(jnp.float32) / self.axis_size
+        if self.seq_axis is not None and self.seq_size > 1:
+            g_mine = lax.psum(g_mine, self.seq_axis) / self.seq_size
+        present = self._present(spec)
+        for a in self.shard_axes:
+            if a not in present:
+                g_mine = lax.pmean(g_mine, a)
+        return g_mine
+
     def _mean_chunk(self, g, spec):
         """Inside shard_map: LOCAL (pre-sync) grad leaf -> this device's
         f32 chunk of the data-mean gradient. The psum_scatter IS the
         data reduction (half an allreduce's bytes, pre-sharded); seq
         replicas average on the chunk; leaves replicated over a shard
         axis get that axis's drift-guard pmean (their grads are already
-        identical across its shards)."""
+        identical across its shards). Expert-parallel leaves skip the
+        chunking entirely (``_expert_mean``)."""
+        if self._data_sharded(spec):
+            return self._expert_mean(g, spec)
         s = self.axis_size
         chunk = self._chunk(g.size)  # g.size = LOCAL model-shard size
         pad = s * chunk - g.size
@@ -543,7 +582,15 @@ class Zero1Adam:
         chunks = jax.tree.map(self._mean_chunk, grads, specs)
         chunks = self._clip_chunks(chunks, specs)
 
-        def leaf(p, g_mine, *moms):
+        def leaf(p, g_mine, spec, *moms):
+            if self._data_sharded(spec):
+                # Expert-local: full-shape update on this device's
+                # experts, no collectives (state is already partitioned).
+                p32 = p.astype(jnp.float32)
+                new_moms, update = self._chunk_rule(
+                    p32, list(moms), g_mine, c1, c2
+                )
+                return ((p32 - lr * update).astype(p.dtype), *new_moms)
             chunk = g_mine.shape[-1]
             pad = s * chunk - p.size
             p2d = jnp.pad(
@@ -564,7 +611,7 @@ class Zero1Adam:
             )
 
         out = jax.tree.map(
-            leaf, params, chunks, *[state[n] for n in self.MOMENTS]
+            leaf, params, chunks, specs, *[state[n] for n in self.MOMENTS]
         )
         pick = lambda i: jax.tree.map(
             lambda _, o: o[i], params, out
@@ -625,6 +672,11 @@ class FsdpAdam(Zero1Adam):
             ).reshape(self.axis_size, chunk)
 
         def leaf(p, spec):
+            if self._data_sharded(spec):
+                # Expert-parallel leaf: already data-sharded — persists
+                # at its natural shape, no flat chunking.
+                return p
+
             def rec(x, axes):
                 if not axes:
                     return rows(x)
@@ -641,13 +693,25 @@ class FsdpAdam(Zero1Adam):
 
         return jax.tree.map(leaf, params, specs)
 
-    def gather_params(self, shards, shape_tree):
+    def gather_params(self, shards, shape_tree, specs=None):
         """Local ``[1, (1,) chunk]`` shards -> LOCAL params (one
         all_gather over the data axis per leaf). ``shape_tree`` carries
         the PER-DEVICE shapes: global shapes for replicated leaves, the
         tensor-shard shapes for tensor-sharded leaves (the trainer
-        precomputes this local tree)."""
-        return _gather_flat(shards, shape_tree, self.axis_name)
+        precomputes this local tree). Expert-parallel leaves (``specs``
+        naming the data axis) pass through untouched — they are stored
+        at their natural local shape."""
+        if specs is None:
+            return _gather_flat(shards, shape_tree, self.axis_name)
+
+        def leaf(sh, sds, spec):
+            if self._data_sharded(spec):
+                return sh.astype(sds.dtype)
+            return _gather_flat(
+                {"x": sh}, {"x": sds}, self.axis_name
+            )["x"]
+
+        return jax.tree.map(leaf, shards, shape_tree, specs)
 
     def unshard_host(self, shards, shape_tree, specs=None):
         """Host-side inverse of ``shard_params`` for export/decode: the
@@ -662,6 +726,10 @@ class FsdpAdam(Zero1Adam):
         def leaf(sh, sds, spec):
             flat = np.asarray(jax.device_get(sh))
             dtype = np.asarray([], sds.dtype).dtype
+            if self._data_sharded(spec):
+                # Expert-parallel leaf: stored at its natural (global)
+                # shape already.
+                return flat.astype(dtype)
 
             def rec(arr, axes, shape):
                 if not axes:
@@ -689,7 +757,11 @@ class FsdpAdam(Zero1Adam):
         """FSDP grads arrive pre-scattered (the ``[1, (1,) chunk]``
         cotangents of ``gather_params`` — the all_gather transpose
         already psum_scattered the data-axis SUM): divide into the mean,
-        seq-pmean, model-axis drift guard for replicated leaves."""
+        seq-pmean, model-axis drift guard for replicated leaves.
+        Expert-parallel leaves pass through the identity gather, so
+        their cotangent is the raw local grad — ``_expert_mean``."""
+        if self._data_sharded(spec):
+            return self._expert_mean(g, spec)
         g_mine = g.reshape(-1).astype(jnp.float32) / self.axis_size
         if self.seq_axis is not None and self.seq_size > 1:
             g_mine = lax.pmean(g_mine, self.seq_axis)
@@ -699,13 +771,22 @@ class FsdpAdam(Zero1Adam):
                 g_mine = lax.pmean(g_mine, a)
         return g_mine
 
-    def _update_shards(self, param_shards, state, chunks, count, lr, c1, c2):
+    def _update_shards(
+        self, param_shards, state, chunks, specs, count, lr, c1, c2
+    ):
         """The shared FSDP update: run the chunk rule on the stored
         local shards against the prepared mean-grad ``chunks``. No
         delta all_gather — params stay sharded (the next step's
-        ``gather_params`` re-materializes them)."""
+        ``gather_params`` re-materializes them). Expert-parallel
+        leaves update at their natural local shape."""
 
-        def leaf(psh, g_mine, *moms):
+        def leaf(psh, g_mine, spec, *moms):
+            if self._data_sharded(spec):
+                p32 = psh.astype(jnp.float32)
+                new_moms, update = self._chunk_rule(
+                    p32, list(moms), g_mine, c1, c2
+                )
+                return ((p32 - lr * update).astype(psh.dtype), *new_moms)
             chunk = psh.shape[-1]
             p_mine = psh.reshape(chunk).astype(jnp.float32)
             new_moms, update = self._chunk_rule(
@@ -718,7 +799,8 @@ class FsdpAdam(Zero1Adam):
             )
 
         out = jax.tree.map(
-            leaf, param_shards, chunks, *[state[n] for n in self.MOMENTS]
+            leaf, param_shards, chunks, specs,
+            *[state[n] for n in self.MOMENTS],
         )
         pick = lambda i: jax.tree.map(lambda _, o: o[i], param_shards, out)
         new_state = {"count": count}
@@ -736,7 +818,7 @@ class FsdpAdam(Zero1Adam):
         chunks = jax.tree.map(self._mean_chunk, grad_chunks, specs)
         chunks = self._clip_chunks(chunks, specs)
         return self._update_shards(
-            param_shards, state, chunks, count, lr, c1, c2
+            param_shards, state, chunks, specs, count, lr, c1, c2
         )
 
     def apply_local_grads(self, param_shards, state, grads, specs=None):
@@ -759,7 +841,7 @@ class FsdpAdam(Zero1Adam):
         )
         chunks = self._clip_chunks(chunks, specs)
         return self._update_shards(
-            param_shards, state, chunks, count, lr, c1, c2
+            param_shards, state, chunks, specs, count, lr, c1, c2
         )
 
 

@@ -417,16 +417,13 @@ class LMTrainer:
             # ZeRO: chunked AdamW with data-axis-sharded state
             # (parallel/zero.py::Zero1Adam / FsdpAdam). Tensor-sharded
             # leaves chunk their LOCAL shard per (data, tensor)
-            # coordinate (round 5); expert-sharded leaves remain out —
-            # their all_to_all grad layout doesn't fit the flat-chunk
-            # scatter.
-            which = "fsdp" if cfg.fsdp else "zero1"
-            if self.expert_parallel:
-                raise ValueError(
-                    f"{which}=True is incompatible with "
-                    "moe_expert_parallel (expert-sharded leaves are not "
-                    "data-replicated)"
-                )
+            # coordinate (round 5). Expert-parallel leaves (late round
+            # 5 — the last ZeRO rejection removed) keep NATURAL-shaped
+            # LOCAL state: EP already shards them over the data axis,
+            # so their optimizer memory is divided by construction and
+            # the update needs no collectives (the all_to_all
+            # transpose delivered full expert grads; sync_grad's EP
+            # scaling moves into the optimizer's _expert_mean).
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpAdam,
                 FsdpLion,
@@ -476,6 +473,10 @@ class LMTrainer:
             self._orig_param_specs = self.param_specs
 
             def chunk_spec(_, spec):
+                if spec_dim(spec, DATA_AXIS) is not None:
+                    # Expert-parallel leaf: natural-shaped local state,
+                    # sharded exactly like the param.
+                    return spec
                 if (
                     self.tensor_size > 1
                     and spec_dim(spec, TENSOR_AXIS) is not None
@@ -505,6 +506,9 @@ class LMTrainer:
                     param_shapes,
                     self._orig_param_specs,
                     {TENSOR_AXIS: self.tensor_size},
+                    # Expert-parallel leaves restore by plain
+                    # re-sharding (natural global shapes) — no re-chunk.
+                    exclude_axis=DATA_AXIS,
                 ),
                 prefixes=("opt_state/mu/", "opt_state/nu/")
                 + (("params/",) if cfg.fsdp else ()),
@@ -756,15 +760,18 @@ class LMTrainer:
         seed = self.cfg.seed
 
         is_fsdp = self.cfg.fsdp
+        orig_specs = self._orig_param_specs
         if is_fsdp:
             # gather_params reconstructs each device's LOCAL view: the
             # full leaf for replicated params, the tensor shard for
-            # tensor-sharded ones.
+            # tensor-sharded ones; expert-parallel leaves pass through
+            # (already local).
             shapes_tree = self._local_param_shapes
-            unshard = lambda ch: zero1_opt.gather_params(ch, shapes_tree)
+            unshard = lambda ch: zero1_opt.gather_params(
+                ch, shapes_tree, orig_specs
+            )
         else:
             unshard = lambda p: p
-        orig_specs = self._orig_param_specs
 
         def local_step(params, opt_state, tokens, targets, step):
             # Dropout rng: keyed by (step, data index, seq index) — NOT

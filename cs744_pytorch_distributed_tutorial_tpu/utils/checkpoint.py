@@ -162,7 +162,23 @@ class Checkpointer:
             reps = -(-n // saved.shape[0])
             return np.tile(saved, (reps,) + (1,) * (saved.ndim - 1))[:n]
 
-        return jax.tree_util.tree_map_with_path(adapt_leaf, raw, template)
+        adapted = jax.tree_util.tree_map_with_path(adapt_leaf, raw, template)
+
+        def place(leaf, like):
+            # Adapted leaves are host numpy; commit them to the
+            # template's sharding NOW. Leaving them uncommitted lets
+            # jit's donation pairing match a donated input against a
+            # same-shaped output of a DIFFERENT sharding (observed on
+            # the mixed chunked/natural ZeRO x EP layout: an XLA
+            # "aliased input/output size" crash on the first resumed
+            # step).
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return leaf  # process-spanning: caller re-places
+            if isinstance(like, jax.Array):
+                return jax.device_put(np.asarray(leaf), like.sharding)
+            return leaf
+
+        return jax.tree.map(place, adapted, template)
 
     def close(self) -> None:
         self.manager.wait_until_finished()

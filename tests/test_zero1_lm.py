@@ -102,19 +102,13 @@ def test_zero1_moments_are_sharded():
 
 def test_zero1_rejections():
     """What remains rejected after the round-5 compositions: unknown
-    optimizer strings (friendly error, not a KeyError) and expert
-    parallelism (all_to_all grad layout does not fit the flat-chunk
-    scatter)."""
+    optimizer strings (friendly error, not a KeyError). Expert
+    parallelism composes since late round 5 —
+    test_zero_expert_parallel_trajectory_matches_replicated."""
     mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="unknown optimizer"):
         LMTrainer(_cfg(data_parallel=2, zero1=True, optimizer="adam"),
                   mesh=mesh)
-    with pytest.raises(ValueError, match="expert"):
-        LMTrainer(
-            _cfg(data_parallel=2, zero1=True, moe_experts=2,
-                 moe_expert_parallel=True),
-            mesh=mesh,
-        )
 
 
 @pytest.mark.parametrize("opt", ["lion", "sgd"])
@@ -441,3 +435,83 @@ def test_fsdp_zero1_mutually_exclusive():
     mesh = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="mutually exclusive"):
         LMTrainer(_cfg(data_parallel=2, zero1=True, fsdp=True), mesh=mesh)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO x expert parallelism (late round 5 — the last ZeRO rejection
+# removed): EP-over-DP expert leaves are ALREADY data-sharded, so their
+# optimizer state stays local at natural shapes (memory divided by
+# construction, zero collectives in their update); everything else
+# chunks as before.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["zero1", "fsdp"])
+def test_zero_expert_parallel_trajectory_matches_replicated(mode):
+    """dp4 + EP(moe) + clip: the mixed layout (chunked replicated
+    leaves, natural-local expert leaves) IS the replicated optimizer —
+    including the exact global-norm clip spanning both leaf kinds."""
+    mesh = make_mesh({"data": 4, "seq": 1}, devices=jax.devices()[:4])
+    kw = dict(
+        data_parallel=4, moe_experts=4, moe_capacity_factor=2.0,
+        moe_expert_parallel=True, grad_clip_norm=0.05,
+    )
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    tr, params, opt, z = _run(
+        _cfg(**kw, zero1=(mode == "zero1"), fsdp=(mode == "fsdp")), mesh
+    )
+    np.testing.assert_allclose(base, z, rtol=2e-5)
+    # Layout of the memory claim: expert moments keep the PARAM's
+    # natural shape sharded over data; replicated leaves chunk
+    # [dp, chunk]; fsdp expert PARAMS stay natural too.
+    moe_mu = opt["mu"]["block_0"]["moe"]["w_in"]
+    assert moe_mu.ndim == 3 and moe_mu.shape[0] == 4  # [E, D, F]
+    assert tuple(moe_mu.sharding.spec)[:1] == ("data",)
+    ln_mu = opt["mu"]["ln_f"]["scale"]
+    assert ln_mu.ndim == 2 and ln_mu.shape[0] == 4  # [dp, chunk]
+    if mode == "fsdp":
+        moe_p = params["block_0"]["moe"]["w_in"]
+        assert moe_p.ndim == 3 and moe_p.shape[0] == 4
+        # decode unshard reassembles global expert arrays
+        host = tr.gather_for_decode(params)
+        assert host["block_0"]["moe"]["w_in"].shape == (4, 32, 64)
+
+
+def test_zero1_expert_parallel_resume(tmp_path):
+    """Mixed-layout checkpoint resume under zero1+EP. Same-dp resume is
+    EXACT (chunked leaves plus natural expert moments restore placed on
+    their shardings — the restore-placement fix this test pinned: an
+    uncommitted host leaf let jit's donation pairing alias a chunked
+    input to a different-sharded output and crash). Cross-dp elastic
+    resume is exercised only MECHANICALLY: EP computes capacity from
+    LOCAL token counts, so changing dp changes routing semantics and
+    the trajectory legitimately diverges from the saved-dp oracle —
+    the assertion is that the re-chunk/re-shard restore runs and
+    training continues finite."""
+    kw = dict(
+        moe_experts=4, moe_capacity_factor=2.0, moe_expert_parallel=True,
+        zero1=True, checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=2,
+    )
+    mesh4 = make_mesh({"data": 4, "seq": 1}, devices=jax.devices()[:4])
+    tokens = synthetic_tokens(8, 16, 64, seed=0)
+    tr = LMTrainer(_cfg(data_parallel=4, **kw), mesh=mesh4)
+    _, _, head = tr.fit(tokens, steps=4)
+    oracle = LMTrainer(
+        _cfg(data_parallel=4, **{**kw, "checkpoint_dir": None}), mesh=mesh4
+    )
+    _, _, full = oracle.fit(tokens, steps=6)
+    # Exact same-dp resume.
+    tr_same = LMTrainer(_cfg(data_parallel=4, **kw), mesh=mesh4)
+    _, _, tail_same = tr_same.fit(tokens, steps=6)
+    assert len(tail_same) == 2, tail_same
+    np.testing.assert_allclose(head + tail_same, full, rtol=1e-6)
+    # Mechanical cross-dp restore (different routing semantics) — from
+    # a fresh step-4 save (the run above already saved its step 6).
+    kw_e = {**kw, "checkpoint_dir": str(tmp_path / "ck_elastic")}
+    tr_h = LMTrainer(_cfg(data_parallel=4, **kw_e), mesh=mesh4)
+    tr_h.fit(tokens, steps=4)
+    mesh2 = make_mesh({"data": 2, "seq": 1}, devices=jax.devices()[:2])
+    tr2 = LMTrainer(_cfg(data_parallel=2, **kw_e), mesh=mesh2)
+    _, _, tail = tr2.fit(tokens, steps=6)
+    assert len(tail) == 2 and np.isfinite(tail).all(), tail

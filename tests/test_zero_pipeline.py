@@ -191,12 +191,8 @@ def test_pipeline_zero1_rejections():
                  optimizer="adam"),
             mesh=_mesh(2, 2),
         )
-    with pytest.raises(ValueError, match="expert"):
-        PipelineLMTrainer(
-            _cfg(data_parallel=2, pipeline_parallel=2, zero1=True,
-                 moe_experts=2, moe_expert_parallel=True),
-            mesh=_mesh(2, 2),
-        )
+    # zero1 x expert parallelism composes since late round 5 —
+    # test_pipeline_zero_expert_parallel below.
 
 
 def test_pipeline_zero1_lion_matches_replicated():
@@ -319,12 +315,31 @@ def test_pipeline_fsdp_rejections():
                  fsdp=True),
             mesh=_mesh(2, 2),
         )
-    with pytest.raises(ValueError, match="expert"):
-        PipelineLMTrainer(
-            _cfg(data_parallel=2, pipeline_parallel=2, fsdp=True,
-                 moe_experts=2, moe_expert_parallel=True),
-            mesh=_mesh(2, 2),
-        )
+
+
+def test_pipeline_zero_expert_parallel():
+    """ZeRO x EP on the pipeline engine (late round 5 — the rejection
+    removed): dp2 x pp2 with experts sharded over data; expert moments
+    keep natural shapes sharded like the params while everything else
+    chunks; trajectory matches the replicated EP run on BOTH zero1 and
+    fsdp."""
+    mesh = _mesh(2, 2)
+    kw = dict(
+        data_parallel=2, pipeline_parallel=2, moe_experts=2,
+        moe_capacity_factor=2.0, moe_expert_parallel=True,
+    )
+    _, _, _, base = _run(_cfg(**kw), mesh)
+    _, _, opt_z, z1 = _run(_cfg(**kw, zero1=True), mesh)
+    _, _, _, fs = _run(_cfg(**kw, fsdp=True), mesh)
+    np.testing.assert_allclose(base, z1, rtol=2e-5)
+    np.testing.assert_allclose(base, fs, rtol=2e-5)
+    # expert moments: natural [L, E, D, F] block layout sharded
+    # (pipe, data); replicated leaves chunk [dp, chunk].
+    moe_mu = opt_z["mu"]["blocks"]["moe"]["w_in"]
+    assert moe_mu.shape[:2] == (4, 2)  # [L, E] leading dims
+    assert tuple(moe_mu.sharding.spec)[:2] == ("pipe", "data")
+    emb_mu = opt_z["mu"]["embed"]
+    assert emb_mu.ndim == 2 and emb_mu.shape[0] == 2  # [dp, chunk]
 
 
 def test_pipeline_zero_interleaved_schedule():
