@@ -88,11 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "drop semantics), or dropless (no capacity — "
                         "ragged grouped matmuls; rejects "
                         "--moe-expert-parallel)")
-    p.add_argument("--moe-gmm-impl", choices=("ragged", "pallas"),
-                   default="ragged",
+    p.add_argument("--moe-gmm-impl", choices=("auto", "ragged", "pallas"),
+                   default="auto",
                    help="grouped-matmul backend for --moe-dispatch "
-                        "dropless: XLA ragged_dot or the Pallas gmm "
-                        "kernel")
+                        "dropless: auto (fused-epilogue Pallas kernels "
+                        "on TPU, ragged_dot elsewhere), ragged, or "
+                        "pallas")
     p.add_argument("--moe-expert-parallel", action="store_true")
     # mesh
     p.add_argument("--data-parallel", type=int, default=1)

@@ -440,7 +440,7 @@ class Block(nn.Module):
     # token movement: einsum | scatter | dropless (no capacity — ragged
     # grouped matmuls, ops/gmm.py)
     moe_dispatch: str = "scatter"
-    moe_gmm_impl: str = "ragged"  # dropless backend: ragged | pallas
+    moe_gmm_impl: str = "auto"  # dropless backend: auto | ragged | pallas
     expert_axis: str | None = None
     expert_axis_size: int = 1
     max_decode_len: int | None = None
@@ -610,7 +610,7 @@ class TransformerLM(nn.Module):
     moe_num_groups: int = 1
     # token movement: einsum | scatter | dropless (ops/gmm.py)
     moe_dispatch: str = "scatter"
-    moe_gmm_impl: str = "ragged"
+    moe_gmm_impl: str = "auto"
     expert_axis: str | None = None
     expert_axis_size: int = 1
     # Rematerialization: recompute each block's activations during the

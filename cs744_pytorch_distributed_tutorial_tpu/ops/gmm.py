@@ -266,8 +266,132 @@ def _gmm_pallas_fwd(lhs, rhs, group_sizes, block_m, block_n, interpret):
 
 def _gmm_pallas_bwd(block_m, block_n, interpret, res, dout):
     lhs, rhs, group_sizes = res
+    return _gmm_bwd_core(
+        lhs, rhs, group_sizes, dout, block_m, block_n, interpret,
+        with_bias=False,
+    )
+
+
+_gmm_pallas.defvjp(_gmm_pallas_fwd, _gmm_pallas_bwd)
+
+
+def _gmm_fused_kernel(block_m: int, act: str, h_dtype,
+                      sg, sm, first, valid, start, end,
+                      lhs_ref, rhs_ref, b_ref, h_ref, z_ref=None):
+    """Grouped matmul with a bias(+activation) EPILOGUE. Each row
+    belongs to exactly one group, so no cross-visit accumulation is
+    needed: a visit writes its group's rows (``where`` on the row
+    mask) and leaves the others to their own visits. When a ``z_ref``
+    output is present (the differentiated gelu path) the
+    pre-activation is emitted too — the backward's gelu' input."""
+    s = pl.program_id(1)
+    g = sg[s]
+    mask = _row_mask(sm[s] * block_m, start[g], end[g], block_m)
+    sel = mask & (valid[s] == 1)
+    x = jnp.where(mask, lhs_ref[...], jnp.zeros_like(lhs_ref[...]))
+    val = jnp.dot(
+        x, rhs_ref[0], preferred_element_type=jnp.float32
+    ) + b_ref[0, 0]
+
+    @pl.when(first[s] == 1)
+    def _init():
+        h_ref[...] = jnp.zeros(h_ref.shape, h_ref.dtype)
+        if z_ref is not None:
+            z_ref[...] = jnp.zeros(z_ref.shape, z_ref.dtype)
+
+    if z_ref is not None:
+        z_ref[...] = jnp.where(sel, val.astype(z_ref.dtype), z_ref[...])
+    out = jax.nn.gelu(val) if act == "gelu" else val
+    h_ref[...] = jnp.where(sel, out.astype(h_dtype), h_ref[...])
+
+
+def _gmm_fused_fwd_impl(lhs, rhs, bias, group_sizes, act, h_dtype,
+                        block_m, block_n, interpret, with_z=False):
+    _require_pltpu()
+    m, k = lhs.shape
+    e, _, n = rhs.shape
+    lhs_p, gs, m_padded = _prep(lhs, group_sizes, block_m, e)
+    bn = min(block_n, n)
+    n_padded = _ceil_to(n, bn)
+    if n_padded != n:
+        rhs = jnp.pad(rhs, ((0, 0), (0, 0), (0, n_padded - n)))
+        bias = jnp.pad(bias, ((0, 0), (0, n_padded - n)))
+    # [E, 1, N]: Mosaic's last-two-dims tiling rule wants the
+    # second-to-last block dim to equal the array's (a (1, bn) block
+    # of [E, N] is rejected; (1, 1, bn) of [E, 1, N] is fine).
+    bias = bias[:, None, :]
+    num_steps = m_padded // block_m + e - 1
+    sg, sm, first, valid, start, end = _step_maps(
+        gs, m_padded, block_m, num_steps
+    )
+    grid = (-(-n // bn), num_steps)
+    kw = {"memory_space": _VMEM} if (_VMEM is not None and not interpret) else {}
+    out_shape = [jax.ShapeDtypeStruct((m_padded, n_padded), h_dtype)]
+    out_specs = [
+        pl.BlockSpec((block_m, bn), lambda j, s, sg, sm, *_: (sm[s], j), **kw)
+    ]
+    if with_z:
+        # Pre-activation residual for the backward's gelu', stored at
+        # the COMPUTE dtype — the same bytes XLA's AD saves on the
+        # unfused path (where the bias+gelu chain runs in h_dtype).
+        out_shape.append(
+            jax.ShapeDtypeStruct((m_padded, n_padded), h_dtype)
+        )
+        out_specs.append(
+            pl.BlockSpec(
+                (block_m, bn), lambda j, s, sg, sm, *_: (sm[s], j), **kw
+            )
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda j, s, sg, sm, *_: (sm[s], 0), **kw),
+            pl.BlockSpec((1, k, bn), lambda j, s, sg, sm, *_: (sg[s], 0, j), **kw),
+            pl.BlockSpec((1, 1, bn), lambda j, s, sg, sm, *_: (sg[s], 0, j), **kw),
+        ],
+        out_specs=tuple(out_specs),
+    )
+    out = pl.pallas_call(
+        partial(_gmm_fused_kernel, block_m, act, h_dtype),
+        grid_spec=grid_spec,
+        out_shape=tuple(out_shape),
+        interpret=interpret,
+    )(sg, sm, first, valid, start, end, lhs_p, rhs, bias)
+    if with_z:
+        return out[0][:m, :n], out[1][:m, :n]
+    return out[0][:m, :n], None
+
+
+def _segment_sum_rows(dout, group_sizes, num_experts, block_m, block_n,
+                      interpret):
+    """Per-group column sums of ``dout`` — the bias gradient — as a
+    tgmm with an all-ones [M, 1] lhs."""
+    ones = jnp.ones((dout.shape[0], 1), jnp.float32)
+    db = _tgmm_impl(
+        ones, dout.astype(jnp.float32), group_sizes, num_experts,
+        block_m, block_n, interpret,
+    )
+    return db.reshape(num_experts, dout.shape[1])
+
+
+def _check_gmm_shapes(lhs, rhs, group_sizes):
+    if lhs.ndim != 2 or rhs.ndim != 3 or lhs.shape[1] != rhs.shape[1]:
+        raise ValueError(
+            f"grouped_matmul shapes: lhs {lhs.shape}, rhs {rhs.shape}"
+        )
+    if group_sizes.shape != (rhs.shape[0],):
+        raise ValueError(
+            f"group_sizes {group_sizes.shape} != [num_groups {rhs.shape[0]}]"
+        )
+
+
+def _gmm_bwd_core(lhs, rhs, group_sizes, dout, block_m, block_n,
+                  interpret, with_bias):
+    """The shared backward of every Pallas grouped-matmul variant:
+    dlhs = gmm(dout, rhsᵀ), drhs = tgmm(lhs, dout), and (for the fused
+    variants) dbias = per-group column sums of dout."""
     dout = dout.astype(jnp.float32)
-    # dx: same kernel, experts transposed ([E, N, K]).
     dlhs = _gmm_fwd_impl(
         dout, jnp.swapaxes(rhs, 1, 2).astype(jnp.float32), group_sizes,
         block_m, block_n, interpret,
@@ -277,10 +401,118 @@ def _gmm_pallas_bwd(block_m, block_n, interpret, res, dout):
         block_m, block_n, interpret,
     ).astype(rhs.dtype)
     gs_ct = np.zeros(group_sizes.shape, jax.dtypes.float0)
-    return dlhs, drhs, gs_ct
+    if not with_bias:
+        return dlhs, drhs, gs_ct
+    dbias = _segment_sum_rows(
+        dout, group_sizes, rhs.shape[0], block_m, block_n, interpret
+    ).astype(jnp.float32)
+    return dlhs, drhs, dbias, gs_ct
 
 
-_gmm_pallas.defvjp(_gmm_pallas_fwd, _gmm_pallas_bwd)
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _gmm_gelu_pallas(lhs, rhs, bias, group_sizes, h_dtype, block_m,
+                     block_n, interpret):
+    # Undifferentiated primal: no z output (an opaque custom call's
+    # outputs cannot be DCE'd, so emitting z here would pay a wasted
+    # [M, N] write on every inference forward).
+    return _gmm_fused_fwd_impl(
+        lhs, rhs, bias, group_sizes, "gelu", h_dtype, block_m, block_n,
+        interpret,
+    )[0]
+
+
+def _gmm_gelu_fwd(lhs, rhs, bias, group_sizes, h_dtype, block_m, block_n,
+                  interpret):
+    h, z = _gmm_fused_fwd_impl(
+        lhs, rhs, bias, group_sizes, "gelu", h_dtype, block_m, block_n,
+        interpret, with_z=True,
+    )
+    return h, (lhs, rhs, group_sizes, z)
+
+
+def _gmm_gelu_bwd(h_dtype, block_m, block_n, interpret, res, dh):
+    lhs, rhs, group_sizes, z = res
+    # dz = dh * gelu'(z) — elementwise; XLA fuses the recompute.
+    zf = z.astype(jnp.float32)
+    _, vjp = jax.vjp(jax.nn.gelu, zf)
+    (dz,) = vjp(dh.astype(jnp.float32))
+    return _gmm_bwd_core(
+        lhs, rhs, group_sizes, dz, block_m, block_n, interpret,
+        with_bias=True,
+    )
+
+
+_gmm_gelu_pallas.defvjp(_gmm_gelu_fwd, _gmm_gelu_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _gmm_bias_pallas(lhs, rhs, bias, group_sizes, h_dtype, block_m,
+                     block_n, interpret):
+    return _gmm_fused_fwd_impl(
+        lhs, rhs, bias, group_sizes, "none", h_dtype, block_m, block_n,
+        interpret,
+    )[0]
+
+
+def _gmm_bias_fwd(lhs, rhs, bias, group_sizes, h_dtype, block_m, block_n,
+                  interpret):
+    h, _ = _gmm_fused_fwd_impl(
+        lhs, rhs, bias, group_sizes, "none", h_dtype, block_m, block_n,
+        interpret,
+    )
+    return h, (lhs, rhs, group_sizes)
+
+
+def _gmm_bias_bwd(h_dtype, block_m, block_n, interpret, res, dout):
+    lhs, rhs, group_sizes = res
+    return _gmm_bwd_core(
+        lhs, rhs, group_sizes, dout, block_m, block_n, interpret,
+        with_bias=True,
+    )
+
+
+_gmm_bias_pallas.defvjp(_gmm_bias_fwd, _gmm_bias_bwd)
+
+
+def grouped_matmul_fused(
+    lhs,
+    rhs,
+    bias,
+    group_sizes,
+    *,
+    activation: str = "none",
+    out_dtype=None,
+    block_m: int = 256,
+    block_n: int = 512,
+    interpret: bool = False,
+):
+    """Pallas-only grouped matmul with the per-group bias (and
+    optionally gelu) fused into the kernel EPILOGUE:
+
+        out[r] = act(lhs[r] @ rhs[g(r)] + bias[g(r)])
+
+    The unfused pallas path pays an extra HBM round-trip of the [M, N]
+    intermediate for the bias/activation elementwise chain (XLA cannot
+    fuse into a Pallas custom call); the epilogue removes it. Under
+    differentiation with ``activation="gelu"`` the forward also
+    stashes the pre-activation at the compute dtype for the backward's
+    gelu' (the same residual bytes XLA's AD saves on the unfused
+    path); the undifferentiated primal emits only the output.
+    Differentiable in lhs/rhs/bias.
+    """
+    _check_gmm_shapes(lhs, rhs, group_sizes)
+    if bias.shape != (rhs.shape[0], rhs.shape[2]):
+        raise ValueError(
+            f"bias {bias.shape} != [groups, N] {(rhs.shape[0], rhs.shape[2])}"
+        )
+    if activation not in ("none", "gelu"):
+        raise ValueError(f"unknown activation {activation!r}")
+    h_dtype = jnp.dtype(out_dtype or lhs.dtype)
+    fn = _gmm_gelu_pallas if activation == "gelu" else _gmm_bias_pallas
+    return fn(
+        lhs, rhs, bias.astype(jnp.float32), group_sizes, h_dtype,
+        block_m, block_n, interpret,
+    )
 
 
 def grouped_matmul(
@@ -303,14 +535,7 @@ def grouped_matmul(
     dynamic values, static shapes) → ``[M, N]``. Differentiable in lhs
     and rhs with both impls.
     """
-    if lhs.ndim != 2 or rhs.ndim != 3 or lhs.shape[1] != rhs.shape[1]:
-        raise ValueError(
-            f"grouped_matmul shapes: lhs {lhs.shape}, rhs {rhs.shape}"
-        )
-    if group_sizes.shape != (rhs.shape[0],):
-        raise ValueError(
-            f"group_sizes {group_sizes.shape} != [num_groups {rhs.shape[0]}]"
-        )
+    _check_gmm_shapes(lhs, rhs, group_sizes)
     if impl == "ragged":
         return lax.ragged_dot(
             lhs, rhs, group_sizes.astype(jnp.int32), precision=precision

@@ -836,7 +836,7 @@ class PipelineLMConfig:
     # token movement: einsum | scatter | dropless (no capacity — ragged
     # grouped matmuls inside the stage FFNs; rejects expert parallelism)
     moe_dispatch: str = "scatter"
-    moe_gmm_impl: str = "ragged"  # dropless backend: ragged | pallas
+    moe_gmm_impl: str = "auto"  # dropless backend: auto | ragged | pallas
     moe_expert_parallel: bool = False
 
     data_parallel: int = 1

@@ -130,9 +130,12 @@ class LMConfig:
     # einsum/scatter share routing and drop semantics exactly;
     # trajectories match to float tolerance.
     moe_dispatch: str = "scatter"
-    # Grouped-matmul backend for moe_dispatch="dropless": "ragged"
-    # (lax.ragged_dot) or "pallas" (the megablox-style TPU kernel).
-    moe_gmm_impl: str = "ragged"
+    # Grouped-matmul backend for moe_dispatch="dropless": "auto"
+    # (default — the Pallas megablox-style kernels with fused bias/gelu
+    # epilogues on TPU, measured 1.13x over ragged_dot in-model;
+    # lax.ragged_dot where kernels would interpret), "pallas", or
+    # "ragged".
+    moe_gmm_impl: str = "auto"
     moe_expert_parallel: bool = False
     moe_aux_coef: float = 0.01
 

@@ -176,3 +176,45 @@ def test_dropless_lm_trains():
     x, y = tr.shard_batch(tokens[:8])
     _, _, m = tr.train_step(params, opt_state, x, y)
     assert float(m["moe_drop"]) == 0.0
+
+
+@pytest.mark.parametrize("act", ["none", "gelu"])
+def test_grouped_matmul_fused_matches_unfused(act):
+    """The fused-epilogue kernels (bias(+gelu) inside the gmm — the
+    in-model Pallas win, benchmarks/README.md) compute exactly the
+    unfused chain, forward and gradients (custom_vjp: dx/dw via the
+    plain kernels, db via a K=1 tgmm segment-sum)."""
+    from cs744_pytorch_distributed_tutorial_tpu.ops.gmm import (
+        grouped_matmul_fused,
+    )
+
+    rng = np.random.default_rng(1)
+    x = jnp.array(rng.standard_normal((24, 8)), jnp.float32)
+    w = jnp.array(rng.standard_normal((4, 8, 12)), jnp.float32)
+    b = jnp.array(rng.standard_normal((4, 12)), jnp.float32)
+    gs = jnp.array([5, 0, 11, 8], jnp.int32)
+    ids = np.repeat(np.arange(4), np.asarray(gs))
+
+    def unfused(x, w, b):
+        z = grouped_matmul(
+            x, w, gs, impl="pallas", block_m=8, block_n=8, interpret=True
+        ) + b[ids]
+        return jax.nn.gelu(z) if act == "gelu" else z
+
+    def fused(x, w, b):
+        return grouped_matmul_fused(
+            x, w, b, gs, activation=act, block_m=8, block_n=8,
+            interpret=True,
+        )
+
+    np.testing.assert_allclose(
+        fused(x, w, b), unfused(x, w, b), rtol=1e-5, atol=1e-5
+    )
+    gf = jax.grad(lambda *a: jnp.sum(fused(*a) ** 2), argnums=(0, 1, 2))(
+        x, w, b
+    )
+    gu = jax.grad(lambda *a: jnp.sum(unfused(*a) ** 2), argnums=(0, 1, 2))(
+        x, w, b
+    )
+    for a, c in zip(gf, gu):
+        np.testing.assert_allclose(a, c, rtol=1e-4, atol=1e-4)
