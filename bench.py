@@ -22,6 +22,7 @@ Prints ONE JSON line:
 
 from __future__ import annotations
 
+import argparse
 import json
 import time
 
@@ -100,10 +101,20 @@ def _measure(trainer, state, x, y, key, steps: int) -> float:
     return steps / (time.perf_counter() - t0)
 
 
-def _bench_at(batch: int, steps: int = MEASURE_STEPS) -> float:
+def _bench_at(
+    batch: int,
+    steps: int = MEASURE_STEPS,
+    sync: str = "auto",
+    grad_compress: str = "none",
+) -> tuple[float, int]:
+    """(samples/sec/chip, analytic gradient-sync payload bytes sent per
+    device per step) for the given sync strategy/compression."""
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.buckets import (
+        sync_bytes_per_step,
+    )
     from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
         shard_global_batch,
     )
@@ -112,7 +123,8 @@ def _bench_at(batch: int, steps: int = MEASURE_STEPS) -> float:
     n_chips = len(jax.devices())
     cfg = TrainConfig(
         model="resnet18",
-        sync="auto",
+        sync=sync,
+        grad_compress=grad_compress,
         num_devices=n_chips,
         global_batch_size=batch,
         compute_dtype="bfloat16",
@@ -121,18 +133,63 @@ def _bench_at(batch: int, steps: int = MEASURE_STEPS) -> float:
     mesh = make_mesh({"data": n_chips})
     trainer = Trainer(cfg, mesh=mesh)
     state = trainer.init()
+    wire = sync_bytes_per_step(
+        state.params,
+        "int8_allreduce" if trainer._compress else sync,
+        n_chips,
+    )
     ds = synthetic_cifar10(batch, 16, seed=0)
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
     sps = _measure(trainer, state, x, y, key, steps) * batch
-    return sps / n_chips
+    return sps / n_chips, wire
+
+
+def sync_compare(batch: int = BATCH_SMALL, steps: int = MEASURE_STEPS) -> None:
+    """Bytes-on-wire mode: samples/sec/chip AND analytic gradient payload
+    bytes sent per device per step, one JSON line per sync setting —
+    f32 per-leaf ('auto', the DDP analog), f32 bucketed flat allreduce,
+    and the int8-quantized bucket allreduce with error feedback."""
+    for label, sync, compress in (
+        ("f32_per_leaf_auto", "auto", "none"),
+        ("f32_bucketed_allreduce", "allreduce", "none"),
+        ("int8_bucketed_allreduce", "allreduce", "int8"),
+    ):
+        sps, wire = _bench_at(batch, steps, sync=sync, grad_compress=compress)
+        print(
+            json.dumps(
+                {
+                    "metric": "cifar10_resnet18_grad_sync",
+                    "sync": label,
+                    "batch": batch,
+                    "samples_per_sec_per_chip": round(sps, 1),
+                    "grad_sync_bytes_per_step": wire,
+                }
+            )
+        )
+
+
+def _parse_args() -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--sync-compare",
+        action="store_true",
+        help="report samples/sec/chip and gradient bytes-on-wire per "
+        "step for f32 per-leaf / f32 bucketed / int8 bucketed sync "
+        "instead of the headline benchmark",
+    )
+    return p.parse_args()
 
 
 def main() -> None:
-    sps_big = _bench_at(GLOBAL_BATCH)
+    args = _parse_args()
+    if args.sync_compare:
+        sync_compare()
+        return
+    sps_big, wire = _bench_at(GLOBAL_BATCH)
     # Smaller batch -> shorter steps -> the tunnel's variable dispatch
     # jitter is a bigger fraction; a longer window stabilizes it.
-    sps_small = _bench_at(BATCH_SMALL, steps=90)
+    sps_small, _ = _bench_at(BATCH_SMALL, steps=90)
     flops = resnet18_cifar_train_flops_per_sample()
     print(
         json.dumps(
@@ -150,6 +207,10 @@ def main() -> None:
                 # v5e bf16 peak. null off-TPU — the peak constant
                 # would make any other backend's figure meaningless.
                 "flops_per_sample": flops,
+                # Analytic gradient-sync payload bytes SENT per device
+                # per step under the configured sync (0 for 'auto' on
+                # one chip; parallel/buckets.py::sync_bytes_per_step).
+                "grad_sync_bytes_per_step": wire,
                 "mfu": (
                     round(sps_big * flops / V5E_PEAK_FLOPS, 4)
                     if jax.default_backend() != "cpu"

@@ -91,9 +91,24 @@ class TrainConfig:
     accum_steps: int = 1
 
     # Parallelism
-    sync: str = "allreduce"  # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp
+    # none|gather_scatter|p2p_star|allreduce|ring|auto|zero1|fsdp
+    # |int8_allreduce|int8_ring (quantized wire formats — see grad_compress)
+    sync: str = "allreduce"
     num_devices: int | None = None  # None = all visible devices
     mesh_axes: dict[str, int] | None = None  # overrides num_devices; e.g. {"data": 4}
+    # Gradient compression on the sync wire (parallel/sync.py):
+    # "none" ships f32; "int8" quantizes each bucket per-chunk to int8 +
+    # f32 scales (~3.9x fewer gradient bytes) and carries the
+    # quantization residual as per-device error feedback so compression
+    # error does not bias SGD. "int8" requires sync in
+    # {allreduce, ring, int8_allreduce, int8_ring}; naming an int8_*
+    # sync strategy implies grad_compress="int8".
+    grad_compress: str = "none"  # "none" | "int8"
+    # Bucket size (MiB) for coalesced gradient sync (parallel/buckets.py):
+    # allreduce/ring/zero1/fsdp issue one collective per ~this many
+    # megabytes instead of one per parameter leaf (DDP's bucketing
+    # reducer). 0 disables bucketing (per-leaf collectives).
+    sync_bucket_mb: float = 4.0
 
     # Numerics: params/BN stats stay float32; compute dtype is the MXU knob.
     compute_dtype: str = "float32"  # "bfloat16" on real TPU runs

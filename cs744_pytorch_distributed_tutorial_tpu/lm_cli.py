@@ -130,6 +130,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--weight-decay", type=float, default=1e-4)
     p.add_argument("--grad-clip-norm", type=float, default=None)
+    p.add_argument("--grad-compress", choices=["none", "int8"],
+                   default="none",
+                   help="compress the data-parallel gradient sync: int8 "
+                        "bucket quantization with error feedback (~3.9x "
+                        "fewer gradient bytes; pure-DP layouts only)")
+    p.add_argument("--sync-bucket-mb", type=float, default=4.0,
+                   help="bucket size (MiB) for the compressed sync's "
+                        "coalesced buffers")
     p.add_argument("--label-smoothing", type=float, default=0.0)
     p.add_argument("--dropout-rate", type=float, default=0.0,
                    help="residual dropout on each block's sublayer "
@@ -266,6 +274,9 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
          "the pipeline tail computes plain CE"),
         ("--tie-embeddings", args.tie_embeddings, False,
          "the tied embedding would live in two 1F1B param groups"),
+        ("--grad-compress", args.grad_compress, "none",
+         "stage grads cross the pipe axis per 1F1B group, not as one "
+         "flat data-parallel bucket sync"),
     ):
         if val != default:
             raise SystemExit(
@@ -490,6 +501,8 @@ def main(argv: list[str] | None = None) -> int:
         total_steps=args.steps if args.lr_schedule != "constant" else None,
         weight_decay=args.weight_decay,
         grad_clip_norm=args.grad_clip_norm,
+        grad_compress=args.grad_compress,
+        sync_bucket_mb=args.sync_bucket_mb,
         label_smoothing=args.label_smoothing,
         dropout_rate=args.dropout_rate,
         accum_steps=args.accum_steps,

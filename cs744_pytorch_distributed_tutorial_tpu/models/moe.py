@@ -93,8 +93,9 @@ class MoEFFN(nn.Module):
     #   dynamic counts) and the expert FFN runs as two grouped matmuls
     #   (``ops/gmm.py``: lax.ragged_dot or the Pallas gmm kernel, per
     #   ``gmm_impl``). Every routed token computes — ``moe_drop`` is
-    #   identically 0 and ``capacity_factor``/``num_groups`` are
-    #   ignored. Does NOT compose with ``expert_axis``: EP's all_to_all
+    #   identically 0; non-default ``capacity_factor``/``num_groups``
+    #   are REJECTED (capacity semantics do not exist here).
+    #   Does NOT compose with ``expert_axis``: EP's all_to_all
     #   needs static per-destination counts, which is exactly what
     #   capacity slots buy — dropless + EP would reintroduce them.
     dispatch_impl: str = "scatter"
@@ -132,6 +133,18 @@ class MoEFFN(nn.Module):
                 "expert_axis: EP's all_to_all needs static per-"
                 "destination counts (capacity slots); use 'scatter' or "
                 "'einsum' for expert-parallel layouts"
+            )
+        if dropless and (self.capacity_factor != 1.25 or self.num_groups != 1):
+            # Same reject-don't-drop rule as the expert_axis case: a
+            # non-default capacity/grouping request on the capacity-free
+            # path would silently train different routing semantics than
+            # asked (dropless has no capacity and exactly one group).
+            raise ValueError(
+                "dispatch_impl='dropless' ignores capacity_factor and "
+                f"num_groups (got capacity_factor={self.capacity_factor}, "
+                f"num_groups={self.num_groups}); leave them at the "
+                "defaults (1.25, 1) or use 'scatter'/'einsum' for "
+                "capacity-based routing"
             )
         if e % (self.expert_axis_size if ep else 1):
             raise ValueError(

@@ -60,6 +60,31 @@ def quantize_int8(w: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+def quantize_chunked(x: jax.Array, chunk: int) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-chunk int8 quantization of a flat f32 buffer whose
+    size is a multiple of ``chunk``: returns ``(q int8 [m, chunk],
+    scale f32 [m])`` with ``dequantize_chunked(q, scale) ~= x``. The same
+    max-abs/127 scheme as ``quantize_int8``, but grouped along the buffer
+    (gradient-sync payloads have no channel structure to exploit).
+    All-zero chunks get scale 1 (and stay zero)."""
+    if x.ndim != 1 or x.size % chunk:
+        raise ValueError(
+            f"quantize_chunked expects a flat buffer sized a multiple of "
+            f"{chunk}, got shape {x.shape}"
+        )
+    x2 = x.astype(jnp.float32).reshape(-1, chunk)
+    amax = jnp.max(jnp.abs(x2), axis=1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x2 / scale[:, None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_chunked(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Inverse companion of ``quantize_chunked``: ``[m, chunk]`` int8 +
+    ``[m]`` f32 scales -> flat f32 buffer."""
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+
+
 def int8_matmul_ref(x: jax.Array, q: jax.Array, scale: jax.Array) -> jax.Array:
     """XLA reference semantics of the kernel: widen-to-activation-dtype
     matmul with f32 accumulation, then the per-channel scale. Used as the

@@ -118,12 +118,38 @@ def ring_all_reduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
     n = axis_size
     if n == 1:
         return x
-    idx = lax.axis_index(axis_name)
     orig_shape, orig_size = x.shape, x.size
     pad = (-orig_size) % n
     flat = jnp.pad(x.reshape(-1), (0, pad))
     chunks = flat.reshape(n, -1)  # chunk c lives at row c
 
+    chunks = ring_all_reduce_rows(chunks, axis_name, n)
+
+    out = chunks.reshape(-1)
+    if pad:
+        out = out[:orig_size]
+    return out.reshape(orig_shape)
+
+
+def ring_all_reduce_rows(
+    chunks: jax.Array, axis_name: str, axis_size: int
+) -> jax.Array:
+    """Ring allreduce of a pre-chunked ``[axis_size, cols]`` matrix whose
+    row ``c`` is ring chunk ``c``; returns the summed matrix. The core of
+    ``ring_all_reduce``, exposed so the bucketed sync path
+    (``parallel/buckets.py``) can run MANY leaves' row-blocks through ONE
+    ring: an element's floating-point accumulation order depends only on
+    its row and the ring position, so concatenating per-leaf ``[n,
+    chunk_l]`` blocks along columns keeps the result bitwise-identical to
+    the per-leaf calls."""
+    n = axis_size
+    if n == 1:
+        return chunks
+    if chunks.shape[0] != n:
+        raise ValueError(
+            f"expected [{n}, cols] chunk rows, got shape {chunks.shape}"
+        )
+    idx = lax.axis_index(axis_name)
     up = [(i, (i + 1) % n) for i in range(n)]
 
     # Reduce-scatter: at step s, device i sends its running sum of chunk
@@ -150,12 +176,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:
         recv_row = (idx - s) % n
         return lax.dynamic_update_index_in_dim(chunks, recvd, recv_row, axis=0)
 
-    chunks = lax.fori_loop(0, n - 1, ag_step, chunks)
-
-    out = chunks.reshape(-1)
-    if pad:
-        out = out[:orig_size]
-    return out.reshape(orig_shape)
+    return lax.fori_loop(0, n - 1, ag_step, chunks)
 
 
 def ring_all_reduce_mean(x: jax.Array, axis_name: str, axis_size: int) -> jax.Array:

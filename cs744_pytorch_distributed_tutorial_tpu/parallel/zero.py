@@ -220,12 +220,24 @@ class Zero1SGD:
         weight_decay: float,
         axis_name: str,
         axis_size: int,
+        bucket_bytes: int | None = None,
     ):
         self.learning_rate = learning_rate
         self.momentum = momentum
         self.weight_decay = weight_decay
         self.axis_name = axis_name
         self.axis_size = axis_size
+        # DDP-style bucketing of the reduce-scatter / all_gather pair
+        # (parallel/buckets.py): None/0 keeps one collective pair per
+        # leaf; otherwise leaves coalesce into ~bucket_bytes buffers and
+        # each step issues one pair per BUCKET.
+        from cs744_pytorch_distributed_tutorial_tpu.parallel.buckets import (
+            DEFAULT_BUCKET_BYTES,
+        )
+
+        self.bucket_bytes = (
+            DEFAULT_BUCKET_BYTES if bucket_bytes is None else int(bucket_bytes)
+        )
 
     def _chunk(self, size: int) -> int:
         return -(-size // self.axis_size)  # ceil
@@ -247,7 +259,14 @@ class Zero1SGD:
 
     def apply(self, params, momenta, grads):
         """One ZeRO-1 step on local LOCAL grads (pre-sync): returns
-        (replicated new params, local momentum shards)."""
+        (replicated new params, local momentum shards). With
+        ``bucket_bytes`` set (the default) the per-leaf psum_scatter /
+        all_gather pair collapses to one pair per BUCKET: leaves'
+        ``[axis_size, chunk]`` blocks concatenate along columns (same row
+        placement, so each element's reduction is unchanged) and the
+        parameter deltas gather back as one flat buffer per bucket."""
+        if self.bucket_bytes and self.axis_size > 1:
+            return self._apply_bucketed(params, momenta, grads)
         s = self.axis_size
 
         def leaf(p, m, g):
@@ -273,6 +292,49 @@ class Zero1SGD:
         new_params = jax.tree.map(lambda _, o: o[0], params, out)
         new_momenta = jax.tree.map(lambda _, o: o[1], params, out)
         return new_params, new_momenta
+
+    def _apply_bucketed(self, params, momenta, grads):
+        from cs744_pytorch_distributed_tutorial_tpu.parallel import buckets as B
+
+        s = self.axis_size
+        idx = lax.axis_index(self.axis_name)
+        layout = B.bucket_layout(grads, self.bucket_bytes, rows=s)
+        # [s, cols] buffers; one reduce-scatter per bucket delivers this
+        # device's row of the gradient SUM, divided into the mean.
+        g_mine_bufs = [
+            lax.psum_scatter(buf, self.axis_name, scatter_dimension=0) / s
+            for buf in B.flatten_for_sync(grads, layout)
+        ]
+        leaves_p, treedef = jax.tree.flatten(params)
+        leaves_m = jax.tree.leaves(momenta)
+        delta_parts: list[list] = [[] for _ in g_mine_bufs]
+        new_m_leaves = []
+        for p, m, slot in zip(leaves_p, leaves_m, layout.slots):
+            chunk = slot.size
+            g_mine = g_mine_bufs[slot.bucket][slot.offset : slot.offset + chunk]
+            pad = s * chunk - p.size
+            p2d = jnp.pad(p.ravel(), (0, pad)).reshape(s, chunk)
+            p_mine = lax.dynamic_index_in_dim(p2d, idx, 0, keepdims=False)
+            m_new, delta_mine = self._sgd_chunk_update(
+                p_mine, m.reshape(chunk), g_mine
+            )
+            delta_parts[slot.bucket].append(delta_mine)
+            new_m_leaves.append(m_new.reshape(1, chunk))
+        # One all_gather per bucket restores every device's deltas.
+        delta_bufs = [
+            lax.all_gather(jnp.concatenate(ps), self.axis_name, axis=0)
+            for ps in delta_parts
+        ]
+        new_p_leaves = []
+        for p, slot in zip(leaves_p, layout.slots):
+            chunk = slot.size
+            delta = delta_bufs[slot.bucket][:, slot.offset : slot.offset + chunk]
+            delta_flat = delta.reshape(s * chunk)[: p.size]
+            new_p_leaves.append(p + delta_flat.reshape(p.shape))
+        return (
+            jax.tree.unflatten(treedef, new_p_leaves),
+            jax.tree.unflatten(treedef, new_m_leaves),
+        )
 
 
 class FsdpSGD(Zero1SGD):
@@ -309,8 +371,38 @@ class FsdpSGD(Zero1SGD):
         return _shard_flat(params, self.axis_size)
 
     def gather_params(self, shards, shape_tree):
-        """Local ``[1, chunk]`` shards -> full params (``_gather_flat``)."""
-        return _gather_flat(shards, shape_tree, self.axis_name)
+        """Local ``[1, chunk]`` shards -> full params. Bucketed by default
+        (one ``all_gather`` per bucket instead of per leaf): local chunks
+        concatenate into flat buffers, gather as ``[axis_size, cols]``,
+        and leaves slice back out. Differentiating through this unshard
+        still delivers reduce-scattered gradients — the AD transpose of
+        the bucketed all_gather is ONE ``psum_scatter`` per bucket, with
+        the concatenation transposing to the per-leaf split."""
+        if not (self.bucket_bytes and self.axis_size > 1):
+            return _gather_flat(shards, shape_tree, self.axis_name)
+        from cs744_pytorch_distributed_tutorial_tpu.parallel import buckets as B
+
+        s = self.axis_size
+        layout = B.bucket_layout(shape_tree, self.bucket_bytes, rows=s)
+        leaves_sh = jax.tree.leaves(shards)
+        parts: list[list] = [[] for _ in layout.bucket_cols]
+        for sh, slot in zip(leaves_sh, layout.slots):
+            parts[slot.bucket].append(sh.reshape(-1))
+        gathered = [
+            lax.all_gather(jnp.concatenate(ps), self.axis_name, axis=0)
+            for ps in parts
+        ]  # [s, cols] per bucket
+        leaves_shape, treedef = jax.tree.flatten(shape_tree)
+        out = []
+        for sds, slot in zip(leaves_shape, layout.slots):
+            chunk = slot.size
+            full = gathered[slot.bucket][:, slot.offset : slot.offset + chunk]
+            out.append(
+                full.reshape(-1)[: math.prod(sds.shape)]
+                .reshape(sds.shape)
+                .astype(sds.dtype)
+            )
+        return jax.tree.unflatten(treedef, out)
 
     def apply(self, param_shards, momenta, grad_chunks):
         """One FSDP step from CHUNKED grad sums (the ``[1, chunk]``

@@ -60,6 +60,7 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
     UNCHECKED_REPLICATION,
     get_sync,
     sync_grads,
+    sync_grads_compressed,
 )
 from cs744_pytorch_distributed_tutorial_tpu.train.state import (
     TrainState,
@@ -221,6 +222,11 @@ class Trainer:
                     f"sync={cfg.sync!r} fused_optimizer={cfg.fused_optimizer} "
                     "hard-code unclipped SGD(momentum) at a fixed lr"
                 )
+        if cfg.sync_bucket_mb < 0:
+            raise ValueError(
+                f"sync_bucket_mb must be >= 0, got {cfg.sync_bucket_mb}"
+            )
+        self._bucket_bytes = int(cfg.sync_bucket_mb * 2**20)
         if self._zero1 or self._fsdp:
             from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
                 FsdpSGD,
@@ -234,6 +240,7 @@ class Trainer:
                 cfg.weight_decay,
                 DATA_AXIS,
                 self.axis_size,
+                bucket_bytes=self._bucket_bytes,
             )
         elif cfg.fused_optimizer:
             from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
@@ -252,7 +259,46 @@ class Trainer:
             self.tx = make_optimizer(cfg)
         self.log = get_logger()
         self._sync_fn = get_sync(cfg.sync)
-        self._check_vma = cfg.sync not in UNCHECKED_REPLICATION
+        if cfg.grad_compress not in ("none", "int8"):
+            raise ValueError(
+                f"unknown grad_compress {cfg.grad_compress!r}; choose "
+                "'none' or 'int8'"
+            )
+        # Naming an int8_* sync strategy implies compression; either way
+        # the engine routes the sync through sync_grads_compressed so the
+        # quantization residual persists as per-device error feedback.
+        self._compress = cfg.grad_compress == "int8" or cfg.sync in (
+            "int8_allreduce",
+            "int8_ring",
+        )
+        if self._compress:
+            if cfg.sync not in (
+                "allreduce",
+                "ring",
+                "int8_allreduce",
+                "int8_ring",
+            ):
+                raise ValueError(
+                    "grad_compress='int8' applies to the flat allreduce "
+                    "syncs only (allreduce, ring, int8_allreduce, "
+                    f"int8_ring); sync={cfg.sync!r} either has no grad-sync "
+                    "pass to compress (zero1/fsdp/auto/none) or exists to "
+                    "teach an uncompressed wire shape (gather_scatter, "
+                    "p2p_star)"
+                )
+            if cfg.fused_optimizer:
+                raise ValueError(
+                    "grad_compress='int8' does not compose with "
+                    "fused_optimizer (the fused kernel consumes per-leaf "
+                    "grads; the compressed sync hands back bucket-dequantized "
+                    "leaves plus error-feedback state the kernel cannot carry)"
+                )
+        self._compress_ring = cfg.sync in ("ring", "int8_ring")
+        # The compressed path's all_to_all/all_gather/ppermute outputs are
+        # replication-unprovable, like the explicit manual strategies.
+        self._check_vma = (
+            cfg.sync not in UNCHECKED_REPLICATION and not self._compress
+        )
         if cfg.hang_action not in ("log", "abort"):
             raise ValueError(
                 f"unknown hang_action {cfg.hang_action!r}; choose 'log' or 'abort'"
@@ -286,6 +332,10 @@ class Trainer:
             params=P(DATA_AXIS) if self._fsdp else P(),
             batch_stats=P(DATA_AXIS),
             opt_state=P(DATA_AXIS) if sharded else P(),
+            # Error-feedback residuals are per-device (like batch_stats):
+            # [num_devices, *param_shape] along the data axis. Empty
+            # pytree (no leaves) when compression is off.
+            ef=P(DATA_AXIS) if self._compress else P(),
         )
 
     def _build_steps(self) -> None:
@@ -362,7 +412,18 @@ class Trainer:
                 (local_loss, new_stats), grads = jax.value_and_grad(
                     local_loss_fn, has_aux=True
                 )(params_local)
-                grads = sync_grads(grads, cfg.sync, DATA_AXIS, axis_size)
+                if not self._compress:
+                    grads = sync_grads(
+                        grads,
+                        cfg.sync,
+                        DATA_AXIS,
+                        axis_size,
+                        bucket_bytes=self._bucket_bytes,
+                    )
+                # Compressed sync happens ONCE per step, after gradient
+                # accumulation (local_train_step): quantizing each
+                # microbatch separately would decouple the error-feedback
+                # residual from what was actually transmitted.
                 loss = lax.pmean(local_loss, DATA_AXIS)
             return loss, local_loss, grads, new_stats
 
@@ -424,6 +485,25 @@ class Trainer:
                 loss = l_sum / accum
                 local_loss = ll_sum / accum
 
+            new_ef = state.ef
+            if self._compress:
+                # Quantized all-reduce of the ACCUMULATED local gradient,
+                # with this device's untransmitted residual added before
+                # quantization and the new residual carried to next step.
+                # Global-norm clipping still sees the dequantized mean:
+                # make_optimizer chains clip_by_global_norm ahead of the
+                # optimizer, downstream of this sync.
+                ef_local = jax.tree.map(lambda a: a[0], state.ef)
+                grads, ef_out = sync_grads_compressed(
+                    grads,
+                    ef_local,
+                    "int8_ring" if self._compress_ring else "int8_allreduce",
+                    DATA_AXIS,
+                    axis_size,
+                    bucket_bytes=self._bucket_bytes,
+                )
+                new_ef = jax.tree.map(lambda a: a[None], ef_out)
+
             if self._zero1 or self._fsdp or cfg.fused_optimizer:
                 # Under zero1 the grads are still LOCAL here: Zero1SGD
                 # fuses the averaging (reduce-scatter) into its sharded
@@ -459,6 +539,7 @@ class Trainer:
                 params=new_params,
                 batch_stats=jax.tree.map(lambda a: a[None], new_stats),
                 opt_state=new_opt,
+                ef=new_ef,
             )
             return new_state, metrics
 
@@ -548,6 +629,18 @@ class Trainer:
             # The full replica existed only for initialization; persist the
             # [axis_size, chunk] flat shards (ZeRO-3's memory contract).
             state = state.replace(params=self.tx.shard_params(state.params))
+        if self._compress:
+            # Error feedback starts at zero: step 0's quantization residual
+            # is the first thing fed back. f32 regardless of param dtype —
+            # the residual must represent values below the int8 step size.
+            state = state.replace(
+                ef=jax.tree.map(
+                    lambda p: jnp.zeros(
+                        (self.axis_size, *p.shape), jnp.float32
+                    ),
+                    state.params,
+                )
+            )
         return self.place_state(state)
 
     def place_state(self, state: TrainState) -> TrainState:
@@ -566,6 +659,9 @@ class Trainer:
             opt_state=host_to_global(
                 state.opt_state, dev if sharded_opt else rep
             ),
+            # ef leaves are [num_devices, ...] like batch_stats; an empty
+            # tree (compression off) passes through host_to_global unchanged.
+            ef=host_to_global(state.ef, dev),
         )
 
     # ------------------------------------------------------------------ loops

@@ -156,6 +156,20 @@ class LMConfig:
     # The standard long-context stabilizer (loss spikes on long sequences).
     grad_clip_norm: float | None = None
 
+    # Gradient compression on the data-parallel sync (parallel/sync.py,
+    # same semantics as the CIFAR engine's TrainConfig.grad_compress):
+    # "int8" quantizes each gradient bucket per-chunk to int8 + f32
+    # scales and carries the quantization residual as per-device error
+    # feedback inside the optimizer state. Pure-DP layouts only
+    # (tensor_parallel == seq_parallel == 1, no zero1/fsdp/EP): those
+    # paths ship grads on different wires (psum_scatter chunks, local
+    # tensor shards) that the bucket quantizer does not model. The clip
+    # still sees the dequantized mean (make_optimizer chains it first).
+    grad_compress: str = "none"  # "none" | "int8"
+    # Bucket size (MiB) for the compressed sync's coalesced buffers;
+    # 0 falls back to the default bucket size.
+    sync_bucket_mb: float = 4.0
+
     # Rematerialization: recompute block activations in backward instead
     # of storing them (jax.checkpoint) — identical numerics, O(layers)
     # less activation HBM, one extra forward of FLOPs. remat_policy
@@ -352,6 +366,31 @@ class LMTrainer:
                 "per-destination counts (capacity slots); use "
                 "moe_dispatch='scatter' for expert-parallel layouts"
             )
+        if cfg.grad_compress not in ("none", "int8"):
+            raise ValueError(
+                f"unknown grad_compress {cfg.grad_compress!r}; choose "
+                "'none' or 'int8'"
+            )
+        self._compress = cfg.grad_compress == "int8"
+        if self._compress and (
+            self.seq_size > 1
+            or self.tensor_size > 1
+            or cfg.zero1
+            or cfg.fsdp
+            or self.expert_parallel
+        ):
+            raise ValueError(
+                "grad_compress='int8' requires a pure data-parallel layout "
+                "(tensor_parallel == seq_parallel == 1, no zero1/fsdp, no "
+                "expert parallelism): the quantized bucket all-reduce "
+                "models the plain data-axis gradient pmean, not "
+                "psum_scatter chunks or locally-sharded grads"
+            )
+        if cfg.sync_bucket_mb < 0:
+            raise ValueError(
+                f"sync_bucket_mb must be >= 0, got {cfg.sync_bucket_mb}"
+            )
+        self._bucket_bytes = int(cfg.sync_bucket_mb * 2**20)
         dtype = resolve_dtype(cfg.compute_dtype)
         flash_interpret = interpret_kernels(self.mesh)
         self._flash_interpret = flash_interpret
@@ -558,6 +597,17 @@ class LMTrainer:
                 self.param_specs,
                 transform_non_params=lambda _: P(),
             )
+        if self._compress:
+            # Error-feedback residuals ride inside the optimizer state as
+            # a 2-tuple (tx_state, ef_tree): they are step-carried
+            # per-DEVICE state, and train_step's (params, opt_state)
+            # signature — and the checkpoint layout, which snapshots
+            # opt_state — stays unchanged. ef leaves are
+            # [data_parallel, *param_shape] f32 sharded over the data axis.
+            self.opt_specs = (
+                self.opt_specs,
+                jax.tree.map(lambda _: P(DATA_AXIS), param_shapes),
+            )
         self._build_steps()
 
     def _init_model(self) -> TransformerLM:
@@ -745,6 +795,12 @@ class LMTrainer:
             return g
 
         accum = self.cfg.accum_steps
+        compress = self._compress
+        bucket_bytes = self._bucket_bytes
+        if compress:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+                sync_grads_compressed,
+            )
 
         fused_xent = self.cfg.fused_xent
         xent_interpret = self._flash_interpret
@@ -777,6 +833,9 @@ class LMTrainer:
             unshard = lambda p: p
 
         def local_step(params, opt_state, tokens, targets, step):
+            if compress:
+                # (tx_state, ef_tree) — see __init__'s opt_specs comment.
+                opt_state, ef = opt_state
             # Dropout rng: keyed by (step, data index, seq index) — NOT
             # the tensor index: the MLP dropout applies to row-parallel
             # partial sums before their psum, so tensor shards must draw
@@ -896,10 +955,30 @@ class LMTrainer:
                 params, opt_state = zero1_opt.apply(
                     params, opt_state, grads, orig_specs
                 )
+            elif compress:
+                # Quantized bucket all-reduce of the accumulated local
+                # gradient with this device's error-feedback residual
+                # folded in; the new residual rides back in opt_state.
+                # Pure DP (validated in __init__), so this one collective
+                # IS the whole sync — no seq/tensor replicas to average.
+                ef_local = jax.tree.map(lambda a: a[0], ef)
+                grads, ef_out = sync_grads_compressed(
+                    grads,
+                    ef_local,
+                    "int8_allreduce",
+                    DATA_AXIS,
+                    data_size,
+                    bucket_bytes=bucket_bytes,
+                )
+                ef = jax.tree.map(lambda a: a[None], ef_out)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             else:
                 grads = jax.tree.map(sync_grad, grads, param_specs)
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
+            if compress:
+                opt_state = (opt_state, ef)
             metrics = {"loss": loss}
             if moe_on:
                 # MoE observability (VERDICT r3 #6): the load-balancing
@@ -972,6 +1051,18 @@ class LMTrainer:
             if self._zero1_opt is not None
             else self.tx.init(params)
         )
+        if self._compress:
+            # Zero error-feedback residuals, one [data_parallel, *shape]
+            # f32 stack per param (each device's row is ITS residual).
+            opt_state = (
+                opt_state,
+                jax.tree.map(
+                    lambda p: jnp.zeros(
+                        (self.data_size, *p.shape), jnp.float32
+                    ),
+                    params,
+                ),
+            )
         if self.cfg.fsdp:
             # Params live chunked from here on (the chunked
             # self.param_specs lay them out below).

@@ -33,6 +33,13 @@ class TrainState:
     params: Any
     batch_stats: Any  # leading [num_devices, ...] axis
     opt_state: Any
+    # Error-feedback residuals for compressed gradient sync
+    # (cfg.grad_compress="int8"): per-DEVICE state shaped
+    # [num_devices, *param_shape] and sharded along the data axis like
+    # batch_stats — each replica's residual is what IT failed to
+    # transmit last step. Empty tuple when compression is off (the
+    # default keeps old checkpoints and construction sites valid).
+    ef: Any = ()
 
 
 def make_schedule(cfg: TrainConfig):

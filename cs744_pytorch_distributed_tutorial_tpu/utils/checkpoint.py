@@ -175,6 +175,19 @@ class Checkpointer:
             if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
                 return leaf  # process-spanning: caller re-places
             if isinstance(like, jax.Array):
+                if (
+                    isinstance(leaf, jax.Array)
+                    and leaf.committed
+                    and leaf.sharding.is_equivalent_to(
+                        like.sharding, leaf.ndim
+                    )
+                ):
+                    # Already a committed device array on the template's
+                    # sharding (same-shape leaves Orbax restored in
+                    # place): the np.asarray round-trip would pull every
+                    # shard to host and re-upload for nothing, and the
+                    # donation-pairing guarantee above already holds.
+                    return leaf
                 return jax.device_put(np.asarray(leaf), like.sharding)
             return leaf
 

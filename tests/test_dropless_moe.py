@@ -139,6 +139,22 @@ def test_dropless_pallas_matches_ragged_in_layer():
     np.testing.assert_allclose(yp, yr, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize(
+    "kw",
+    [dict(capacity_factor=2.0), dict(num_groups=4), dict(num_groups=0)],
+)
+def test_dropless_rejects_capacity_knobs(kw):
+    """dropless has no capacity: a tuned capacity_factor or group count
+    must be rejected loudly, not silently ignored (same reject-don't-
+    drop rule as the expert_axis case)."""
+    x = jnp.zeros((1, 8, 8), jnp.float32)
+    layer = MoEFFN(
+        num_experts=4, d_ff=16, dispatch_impl="dropless", **kw
+    )
+    with pytest.raises(ValueError, match="dropless"):
+        layer.init(jax.random.key(0), x)
+
+
 def test_dropless_rejects_expert_parallel():
     layer = MoEFFN(
         num_experts=4, d_ff=16, dispatch_impl="dropless",

@@ -203,10 +203,13 @@ def test_moe_decode_logits_match_full_forward(dispatch):
     the forward dropped nothing (scatter, cf=4) or the dropless path,
     where nothing can drop by construction. Routing is data-dependent,
     so this also pins that the ragged/slot machinery traces at N=1."""
+    # cf=4 uncaps the scatter forward; dropless rejects non-default
+    # capacity knobs (nothing can drop by construction).
+    cap_kw = {"moe_capacity_factor": 4.0} if dispatch == "scatter" else {}
     model = TransformerLM(
         vocab_size=VOCAB, num_layers=2, num_heads=2, d_model=32, d_ff=64,
         max_seq_len=32, attention_impl="dense", num_experts=4,
-        moe_top_k=2, moe_capacity_factor=4.0, moe_dispatch=dispatch,
+        moe_top_k=2, moe_dispatch=dispatch, **cap_kw,
     )
     toks0 = jnp.zeros((1, 4), jnp.int32)
     params = model.init(jax.random.key(0), toks0)["params"]

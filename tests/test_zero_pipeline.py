@@ -364,11 +364,13 @@ def test_pipeline_dropless_moe_in_stages():
     trace under the scanned stage body): matches the uncapped scatter
     path — same routing, same gates, nothing drops — and rejects EP."""
     mesh = _mesh(2, 2)
-    kw = dict(
-        data_parallel=2, pipeline_parallel=2, moe_experts=4,
-        moe_capacity_factor=4.0,  # uncapped for the scatter oracle
+    kw = dict(data_parallel=2, pipeline_parallel=2, moe_experts=4)
+    # cf=4 uncaps the scatter oracle; dropless rejects non-default
+    # capacity knobs (it has no capacity), so it keeps the default.
+    _, _, _, cap = _run(
+        _cfg(**kw, moe_dispatch="scatter", moe_capacity_factor=4.0),
+        mesh, steps=3,
     )
-    _, _, _, cap = _run(_cfg(**kw, moe_dispatch="scatter"), mesh, steps=3)
     _, _, _, dr = _run(_cfg(**kw, moe_dispatch="dropless"), mesh, steps=3)
     np.testing.assert_allclose(cap, dr, rtol=2e-5)
     with pytest.raises(ValueError, match="dropless"):
