@@ -23,16 +23,28 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import argparse
-import json
+import sys
 import time
 
 import jax
 
+# The analytic FLOPs model and the v5e peak moved to obs/flops.py (the
+# telemetry layer computes live MFU from them); re-exported here so
+# existing scripts importing bench.resnet18_cifar_train_flops_per_sample
+# / bench.V5E_PEAK_FLOPS keep working.
+from cs744_pytorch_distributed_tutorial_tpu.obs.flops import (  # noqa: F401
+    V5E_PEAK_FLOPS,
+    resnet18_cifar_train_flops_per_sample,
+)
+from cs744_pytorch_distributed_tutorial_tpu.obs.sinks import (
+    JsonlSink,
+    MultiSink,
+    StreamSink,
+)
+
 # Round-1 measured values on one TPU v5e chip (bf16, sync='auto'):
 # 32,954.6 sps at the scored batch 4096; ~32.2k at batch 1024.
 ROUND1_BASELINE_SPS = 21_700.0  # the driver's original baseline
-# TPU v5e (v5 lite) peak dense bf16 throughput, per chip.
-V5E_PEAK_FLOPS = 197e12
 GLOBAL_BATCH = 4096
 BATCH_SMALL = 1024
 # The tunneled backend's first executions of a program can pay
@@ -46,29 +58,17 @@ MEASURE_STEPS = 30
 COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
 
 
-def resnet18_cifar_train_flops_per_sample() -> float:
-    """Analytic model FLOPs of one ResNet-18/CIFAR training step, per
-    sample. Convention: FLOPs = 2·MACs; backward = 2x forward (dgrad +
-    wgrad), so train = 3x forward — the standard MFU accounting (the
-    transformer 6ND rule is this same 3x on 2ND). Counts convs, the
-    stage-entry 1x1 projections, and the FC head; BN/ReLU/pool/augment
-    are bandwidth ops and excluded, as MFU convention requires
-    (``models/resnet.py`` cifar_stem architecture: 3x3 stem at 32x32,
-    stages (2,2,2,2) at 64/128/256/512 ch, strides 1/2/2/2)."""
+def _make_sink(metrics_dir: str | None):
+    """Stdout always (the driver scrapes it); a JSONL file too when
+    ``--metrics-dir`` is set — bench results land in the same stream
+    format as training telemetry (``obs/``)."""
+    sinks = [StreamSink(sys.stdout)]
+    if metrics_dir:
+        import os
 
-    def conv(hw: int, cin: int, cout: int, k: int = 3) -> float:
-        return 2.0 * hw * hw * cin * cout * k * k  # per output position
-
-    f = conv(32, 3, 64)  # stem
-    cin = 64
-    for cout, hw in ((64, 32), (128, 16), (256, 8), (512, 4)):
-        f += conv(hw, cin, cout) + conv(hw, cout, cout)  # block 0
-        if cin != cout:  # stage-entry projection shortcut
-            f += conv(hw, cin, cout, k=1)
-        f += 2 * conv(hw, cout, cout)  # block 1
-        cin = cout
-    f += 2.0 * 512 * 10  # FC head
-    return 3.0 * f
+        os.makedirs(metrics_dir, exist_ok=True)
+        sinks.append(JsonlSink(os.path.join(metrics_dir, "metrics.jsonl")))
+    return MultiSink(sinks)
 
 
 def _measure(trainer, state, x, y, key, steps: int) -> float:
@@ -145,7 +145,9 @@ def _bench_at(
     return sps / n_chips, wire
 
 
-def sync_compare(batch: int = BATCH_SMALL, steps: int = MEASURE_STEPS) -> None:
+def sync_compare(
+    sink, batch: int = BATCH_SMALL, steps: int = MEASURE_STEPS
+) -> None:
     """Bytes-on-wire mode: samples/sec/chip AND analytic gradient payload
     bytes sent per device per step, one JSON line per sync setting —
     f32 per-leaf ('auto', the DDP analog), f32 bucketed flat allreduce,
@@ -156,16 +158,16 @@ def sync_compare(batch: int = BATCH_SMALL, steps: int = MEASURE_STEPS) -> None:
         ("int8_bucketed_allreduce", "allreduce", "int8"),
     ):
         sps, wire = _bench_at(batch, steps, sync=sync, grad_compress=compress)
-        print(
-            json.dumps(
-                {
-                    "metric": "cifar10_resnet18_grad_sync",
-                    "sync": label,
-                    "batch": batch,
-                    "samples_per_sec_per_chip": round(sps, 1),
-                    "grad_sync_bytes_per_step": wire,
-                }
-            )
+        sink.emit(
+            {
+                "kind": "bench",
+                "time": time.time(),
+                "metric": "cifar10_resnet18_grad_sync",
+                "sync": label,
+                "batch": batch,
+                "samples_per_sec_per_chip": round(sps, 1),
+                "grad_sync_bytes_per_step": wire,
+            }
         )
 
 
@@ -178,22 +180,31 @@ def _parse_args() -> argparse.Namespace:
         "step for f32 per-leaf / f32 bucketed / int8 bucketed sync "
         "instead of the headline benchmark",
     )
+    p.add_argument(
+        "--metrics-dir",
+        default=None,
+        help="also append the result records to METRICS_DIR/metrics.jsonl "
+        "(the training-telemetry stream format)",
+    )
     return p.parse_args()
 
 
 def main() -> None:
     args = _parse_args()
-    if args.sync_compare:
-        sync_compare()
-        return
-    sps_big, wire = _bench_at(GLOBAL_BATCH)
-    # Smaller batch -> shorter steps -> the tunnel's variable dispatch
-    # jitter is a bigger fraction; a longer window stabilizes it.
-    sps_small, _ = _bench_at(BATCH_SMALL, steps=90)
-    flops = resnet18_cifar_train_flops_per_sample()
-    print(
-        json.dumps(
+    sink = _make_sink(args.metrics_dir)
+    try:
+        if args.sync_compare:
+            sync_compare(sink)
+            return
+        sps_big, wire = _bench_at(GLOBAL_BATCH)
+        # Smaller batch -> shorter steps -> the tunnel's variable dispatch
+        # jitter is a bigger fraction; a longer window stabilizes it.
+        sps_small, _ = _bench_at(BATCH_SMALL, steps=90)
+        flops = resnet18_cifar_train_flops_per_sample()
+        sink.emit(
             {
+                "kind": "bench",
+                "time": time.time(),
                 "metric": "cifar10_resnet18_train_samples_per_sec_per_chip",
                 "value": round(sps_big, 1),
                 "unit": "samples/sec/chip",
@@ -218,7 +229,8 @@ def main() -> None:
                 ),
             }
         )
-    )
+    finally:
+        sink.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,104 @@
+"""Tabulate a telemetry JSONL (obs/) for eyeballing a run.
+
+    python benchmarks/metrics_summary.py /tmp/run/metrics.jsonl
+
+Reads the stream the engines write with ``--metrics-dir`` (or a file
+``bench.py --metrics-dir`` appended to), filters the ``kind == "step"``
+records, and prints a one-screen summary: steps covered, mean step time
+(first emission excluded — it amortizes compile), final/best loss, mean
+MFU where recorded, and total gradient bytes on the wire. Stdlib only —
+usable on any machine the JSONL lands on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any
+
+
+def load_records(path: str) -> list[dict[str, Any]]:
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(f"{path}:{i + 1}: skipping bad line ({e})",
+                      file=sys.stderr)
+    return records
+
+
+def _mean(vals: list[float]) -> float | None:
+    return sum(vals) / len(vals) if vals else None
+
+
+def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
+    """Reduce a record stream to the table rows. Pure — tested directly."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    losses = [r["loss"] for r in steps
+              if isinstance(r.get("loss"), (int, float))]
+    # Drop the first recorded step time: it amortizes XLA compilation
+    # and would dominate short runs.
+    times = [r["step_time_s"] for r in steps
+             if isinstance(r.get("step_time_s"), (int, float))][1:]
+    mfus = [r["mfu"] for r in steps if isinstance(r.get("mfu"), (int, float))]
+    wire = [r["grad_sync_bytes"] for r in steps
+            if isinstance(r.get("grad_sync_bytes"), (int, float))]
+    events = [r for r in records if r.get("kind") == "event"]
+    return {
+        "records": len(records),
+        "step_records": len(steps),
+        "step_range": (
+            (steps[0].get("step"), steps[-1].get("step")) if steps else None
+        ),
+        "mean_step_time_s": _mean(times),
+        "final_loss": losses[-1] if losses else None,
+        "best_loss": min(losses) if losses else None,
+        "mean_mfu": _mean(mfus),
+        "total_grad_sync_bytes": sum(wire) if wire else None,
+        "events": sorted({e.get("event") for e in events}),
+    }
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("jsonl", help="path to a metrics.jsonl")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary as one JSON object instead")
+    args = p.parse_args(argv)
+    summary = summarize(load_records(args.jsonl))
+    if args.json:
+        print(json.dumps(summary))
+        return 0
+    rows = [
+        ("records", summary["records"]),
+        ("step records", summary["step_records"]),
+        ("step range", summary["step_range"]),
+        ("mean step time (s)", summary["mean_step_time_s"]),
+        ("final loss", summary["final_loss"]),
+        ("best loss", summary["best_loss"]),
+        ("mean MFU", summary["mean_mfu"]),
+        ("grad sync bytes (total)", summary["total_grad_sync_bytes"]),
+        ("events", ", ".join(summary["events"]) or None),
+    ]
+    width = max(len(name) for name, _ in rows)
+    for name, val in rows:
+        print(f"{name:<{width}}  {_fmt(val)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

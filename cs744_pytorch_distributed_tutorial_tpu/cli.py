@@ -104,6 +104,12 @@ def build_parser() -> argparse.ArgumentParser:
                    action="store_false", default=None,
                    help="keep training through NaN/inf losses instead of "
                         "raising NonFiniteLossError")
+    p.add_argument("--metrics-dir", default=None,
+                   help="write manifest.json + per-step metrics.jsonl here "
+                        "(obs/; rank 0 only)")
+    p.add_argument("--metrics-every", type=int, default=None,
+                   help="metric emission cadence in steps (default: "
+                        "piggyback on --log-every)")
     p.add_argument("--profile-dir", default=None,
                    help="capture an XLA device trace of a few steps here "
                         "(view in TensorBoard profile / ui.perfetto.dev)")
@@ -170,6 +176,8 @@ _ARG_TO_FIELD = {
     "step_timeout_s": "step_timeout_s",
     "hang_action": "hang_action",
     "halt_on_nonfinite": "halt_on_nonfinite",
+    "metrics_dir": "metrics_dir",
+    "metrics_every": "metrics_every",
     "profile_dir": "profile_dir",
     "profile_start_step": "profile_start_step",
     "profile_num_steps": "profile_num_steps",

@@ -1,0 +1,118 @@
+"""Compatibility shims for older jax releases.
+
+The codebase targets the current shard_map API — ``jax.shard_map`` with
+``check_vma`` and the varying-manual-axes collectives (``lax.pcast``,
+``jax.typeof(...).vma``). On older jax (<= 0.4.x) those names live
+elsewhere or do not exist:
+
+- ``jax.shard_map``          -> ``jax.experimental.shard_map.shard_map``
+- ``check_vma=...``          -> ``check_rep=...`` (see below)
+- ``lax.pcast(x, ax, to=..)``-> ``shard_map.pbroadcast`` (old spelling
+  of replicated->varying; only ``to="varying"`` is ever used here)
+- ``jax.typeof``             -> ``jax.core.get_aval`` (no ``.vma`` attr;
+  every call site already guards with ``getattr(..., "vma", ...)``)
+
+``check_vma`` maps to ``check_rep`` by value. The mapping must NOT be a
+blanket ``check_rep=False``: without the checker the old transposition
+rules reduce to pmap's (``psum`` transposes to ``psum``), which makes
+differentiating a pmean'd loss w.r.t. replicated params return
+unaveraged/axis-size-inflated gradients — the sync-parity suite catches
+this as a ~N_devices blowup on 'auto'. ``check_rep=True`` type-checks
+the manual strategies because ``pcast(..., to="varying")`` lowers to
+``pbroadcast``: params are cast *before* differentiation, so grads come
+out device-varying/local, and the strategy's explicit psum/pmean both
+satisfies the checker and produces replicated outputs. What the old
+checker can NOT do is follow AD-*inserted* collectives (the 'auto'
+path's contract), so ``LEGACY_SHARD_MAP`` is exported for the train
+engine to reroute 'auto'/'none' through the explicit-pmean path —
+numerically identical to what new-jax vma-aware AD inserts. Call sites
+that genuinely cannot be checked (unreduced manual collectives,
+compressed sync) already pass ``check_vma=False`` and flow through to
+``check_rep=False`` unchanged.
+
+Imported for its side effects from the package ``__init__``; a no-op on
+current jax. Set ``CS744_COMPAT=0`` to skip installation (exposes the
+raw API surface, e.g. to reproduce stock-jax behavior in CI matrices).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+
+import jax
+from jax import lax
+
+__all__ = ["LEGACY_SHARD_MAP", "install"]
+
+#: True when this jax predates ``jax.shard_map``/vma tracking and the
+#: shims below are (about to be) installed. Evaluated BEFORE install()
+#: so it reflects the real jax, not the shimmed surface.
+LEGACY_SHARD_MAP: bool = not hasattr(jax, "shard_map")
+
+
+def _install_shard_map() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _legacy_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=bool(check_vma),
+        )
+
+    jax.shard_map = shard_map
+
+
+def _install_pcast() -> None:
+    if hasattr(lax, "pcast"):
+        return
+
+    from jax.experimental.shard_map import pbroadcast as _pbroadcast
+
+    def pcast(x, axis_name, *, to):
+        # Old shard_map spells replicated->varying as pbroadcast; under
+        # check_rep=True it marks x device-varying so downstream explicit
+        # psum/pmean type-check, and its evaluation is the identity.
+        if to != "varying":
+            raise NotImplementedError(
+                f"compat pcast only supports to='varying', got {to!r}"
+            )
+        return _pbroadcast(x, axis_name)
+
+    lax.pcast = pcast
+
+
+def _install_typeof() -> None:
+    if hasattr(jax, "typeof"):
+        return
+
+    def typeof(x):
+        return jax.core.get_aval(x)
+
+    jax.typeof = typeof
+
+
+def install() -> None:
+    if os.environ.get("CS744_COMPAT", "1") == "0":
+        return
+    _install_shard_map()
+    _install_pcast()
+    _install_typeof()
+
+
+install()
+
+# Quiet an inspect oddity: functools.wraps on a function whose original
+# has positional-only markers can confuse signature() consumers; make
+# sure the wrapper is introspectable (best-effort, never fatal).
+try:
+    inspect.signature(jax.shard_map)
+except (TypeError, ValueError):
+    pass

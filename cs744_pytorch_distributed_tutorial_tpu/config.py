@@ -145,6 +145,14 @@ class TrainConfig:
     log_every: int = 20
     timing_batches: tuple[int, int] = (1, 10)  # inclusive range averaged, step 0 (compile) excluded
 
+    # Telemetry (obs/): metrics_dir writes manifest.json + metrics.jsonl
+    # (per-step loss/grad-norm/param-norm/lr/grad_sync_bytes/step-time
+    # records, rank-0 on multihost). metrics_every is the emission
+    # cadence in steps; 0 = piggyback on the log_every cadence, so
+    # telemetry adds no host<->device fetches beyond existing logging.
+    metrics_dir: str | None = None
+    metrics_every: int = 0
+
     # Multi-host rendezvous (mirrors init_process's signature,
     # master/part2a/part2a.py:80-85; JAX derives process_id when None)
     coordinator_address: str | None = None

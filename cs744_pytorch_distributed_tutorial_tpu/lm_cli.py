@@ -162,6 +162,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "restrictions as --zero1")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=20)
+    p.add_argument("--metrics-dir", default=None,
+                   help="write manifest.json + per-step metrics.jsonl here "
+                        "(obs/; rank 0 only)")
+    p.add_argument("--metrics-every", type=int, default=None,
+                   help="metric emission cadence in steps (default 1; the "
+                        "LM loop fetches every step already)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     # data
@@ -277,6 +283,11 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         ("--grad-compress", args.grad_compress, "none",
          "stage grads cross the pipe axis per 1F1B group, not as one "
          "flat data-parallel bucket sync"),
+        ("--metrics-dir", args.metrics_dir, None,
+         "PipelineLMConfig has no telemetry fields; the obs/ sinks wire "
+         "through the shard_map engines only"),
+        ("--metrics-every", args.metrics_every, None,
+         "PipelineLMConfig has no telemetry fields"),
     ):
         if val != default:
             raise SystemExit(
@@ -512,6 +523,8 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         halt_on_nonfinite=args.halt_on_nonfinite,
+        metrics_dir=args.metrics_dir,
+        metrics_every=1 if args.metrics_every is None else args.metrics_every,
     )
     eval_tokens, tokens = _split_eval(
         args.eval_frac, tokens, cfg.global_batch_size
@@ -613,14 +626,46 @@ def main(argv: list[str] | None = None) -> int:
                 max_new_tokens=args.generate,
                 k=args.speculative_k,
                 temperature=args.temperature,
+                return_stats=True,
             )
             spec_args = (host_params, draft_host, prompt_arr[:1])
             if args.temperature > 0.0:
                 # Rejection-sampling mode draws from the target
                 # distribution — it needs the run's rng key.
-                out = spec(*spec_args, jax.random.key(args.seed))
+                out, target_calls = spec(*spec_args, jax.random.key(args.seed))
             else:
-                out = spec(*spec_args)
+                out, target_calls = spec(*spec_args)
+            from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
+                speculative_accept_rate,
+            )
+
+            target_calls = int(target_calls)
+            accept_rate = speculative_accept_rate(
+                args.generate, target_calls, args.speculative_k
+            )
+            print(
+                f"speculative: {target_calls} target calls for "
+                f"{args.generate} tokens (k={args.speculative_k}, "
+                f"accept rate {accept_rate:.3f})"
+            )
+            if args.metrics_dir is not None:
+                # Append to the training run's stream — one timeline per
+                # run, decode stats alongside the step records.
+                from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
+                    Telemetry,
+                )
+
+                _t = Telemetry(args.metrics_dir, run="lm")
+                _t.emit_event(
+                    "speculative_decode",
+                    new_tokens=args.generate,
+                    target_calls=target_calls,
+                    k=args.speculative_k,
+                    accept_rate=accept_rate,
+                    draft_layers=args.draft_layers,
+                    temperature=args.temperature,
+                )
+                _t.close()
         elif args.beam > 0:
             from cs744_pytorch_distributed_tutorial_tpu.infer import (
                 make_beam_searcher,

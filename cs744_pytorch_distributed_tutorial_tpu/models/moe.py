@@ -197,6 +197,19 @@ class MoEFFN(nn.Module):
         )
         self.sow("losses", "moe_aux", aux)
 
+        # Telemetry: normalized entropy of the per-expert token-load
+        # fractions (1.0 = balanced, 0.0 = collapse). Sown into
+        # "metrics" — NOT "losses", which moe_aux_loss() sums blindly.
+        from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
+            expert_load_entropy,
+        )
+
+        self.sow(
+            "metrics",
+            "moe_load_entropy",
+            expert_load_entropy(top1.reshape(-1, e).mean(0)),
+        )
+
         # ---- expert parameters (shared by every dispatch path) ----------
         init = nn.initializers.lecun_normal()
         w_in = self.param("w_in", init, (e_local, d, self.d_ff))

@@ -1,0 +1,55 @@
+"""Unified telemetry: on-device metrics, pluggable sinks, run
+manifests, and HBM/MFU accounting.
+
+Entry point for engines and CLIs is :class:`Telemetry`; everything
+else (sinks, flops models, manifests, system monitors) is importable
+from its submodule for tools that only need one piece.
+"""
+
+from .metrics import (
+    Telemetry,
+    expert_load_entropy,
+    sown_scalar_mean,
+    speculative_accept_rate,
+    tree_l2_norm,
+    tree_sq_norm,
+)
+from .run_manifest import build_manifest, read_manifest, write_manifest
+from .sinks import (
+    CsvSink,
+    JsonlSink,
+    MetricSink,
+    MultiSink,
+    NullSink,
+    RingSink,
+    StreamSink,
+    rank_zero,
+    sanitize,
+)
+from .system import CompileCounter, SystemMonitor, hbm_stats
+from . import flops
+
+__all__ = [
+    "Telemetry",
+    "expert_load_entropy",
+    "sown_scalar_mean",
+    "speculative_accept_rate",
+    "tree_l2_norm",
+    "tree_sq_norm",
+    "build_manifest",
+    "read_manifest",
+    "write_manifest",
+    "CsvSink",
+    "JsonlSink",
+    "MetricSink",
+    "MultiSink",
+    "NullSink",
+    "RingSink",
+    "StreamSink",
+    "rank_zero",
+    "sanitize",
+    "CompileCounter",
+    "SystemMonitor",
+    "hbm_stats",
+    "flops",
+]

@@ -1,0 +1,287 @@
+"""On-device metric computation + the host-side ``Telemetry`` front-end.
+
+Two halves, deliberately in one module so the contract between them is
+visible in one place:
+
+**In-graph helpers** (traced inside the jitted train step) — tree
+norms, MoE expert-load entropy, sown-metric collection. These compute
+scalars *on device*; the host only ever sees them at the existing
+loss-logging fetch, so telemetry adds zero extra device↔host
+round-trips and never breaks async dispatch.
+
+**Host side** — :class:`Telemetry` owns the sinks (an in-memory ring
+always, for the watchdog; a rank-0-gated JSONL when ``metrics_dir`` is
+set), stamps records with run/kind/time, derives amortized
+``step_time_s`` between emissions, and computes MFU when the engine
+declared its analytic FLOPs per step.
+
+Record schema (all records are flat JSON objects):
+
+- ``kind="step"``: ``run, step, time, loss, grad_norm, param_norm,
+  lr, grad_sync_bytes, step_time_s, mfu, ...`` (engine-specific
+  extras such as ``moe_aux`` ride along).
+- ``kind="system"``: HBM + compile counters (see ``obs/system.py``).
+- ``kind="event"``: one-off markers — watchdog firings, divergence
+  verdicts, eval results, speculative-decode stats.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Iterable, Mapping
+
+from .sinks import JsonlSink, MetricSink, MultiSink, RingSink, rank_zero
+from . import flops as _flops
+from . import run_manifest as _run_manifest
+from . import system as _system
+
+__all__ = [
+    "tree_l2_norm",
+    "tree_sq_norm",
+    "expert_load_entropy",
+    "speculative_accept_rate",
+    "sown_scalar_mean",
+    "Telemetry",
+]
+
+METRICS_NAME = "metrics.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# In-graph helpers (trace-time; must stay jit-friendly)
+# ---------------------------------------------------------------------------
+
+
+def tree_sq_norm(tree: Any, specs: Any = None) -> Any:
+    """Sum of squares over a pytree, in f32, as a 0-d array.
+
+    With ``specs`` (a matching pytree of ``PartitionSpec``), each leaf
+    that is *sharded* inside the enclosing ``shard_map`` is psummed
+    over exactly the mesh axes its spec names, so the result is the
+    GLOBAL sum of squares and is identical on every device. Replicated
+    leaves (empty spec) are counted once — no double counting.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def leaf_sq(x: Any) -> Any:
+        return jnp.sum(jnp.square(x.astype(jnp.float32)))
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if specs is None:
+        total = sum((leaf_sq(x) for x in leaves), jnp.float32(0.0))
+        return total
+
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: s is None or hasattr(s, "index")
+    )
+    total = jnp.float32(0.0)
+    for x, spec in zip(leaves, spec_leaves):
+        sq = leaf_sq(x)
+        axes: list[str] = []
+        for entry in tuple(spec or ()):
+            if entry is None:
+                continue
+            if isinstance(entry, (tuple, list)):
+                axes.extend(str(a) for a in entry)
+            else:
+                axes.append(str(entry))
+        if axes:
+            sq = lax.psum(sq, tuple(dict.fromkeys(axes)))
+        total = total + sq
+    return total
+
+
+def tree_l2_norm(tree: Any, specs: Any = None) -> Any:
+    """Global L2 norm of a pytree (see :func:`tree_sq_norm`)."""
+    import jax.numpy as jnp
+
+    return jnp.sqrt(tree_sq_norm(tree, specs))
+
+
+def expert_load_entropy(load: Any) -> Any:
+    """Normalized entropy of per-expert token-load fractions.
+
+    ``load`` is the router's per-expert fraction of tokens (sums to 1
+    over experts). Returns entropy / log(E) in [0, 1]: 1.0 means
+    perfectly balanced routing, 0.0 means total collapse onto one
+    expert. The normalization makes runs with different expert counts
+    comparable on one chart.
+    """
+    import jax.numpy as jnp
+
+    load = load.astype(jnp.float32)
+    e = load.shape[-1]
+    if e <= 1:
+        return jnp.float32(1.0)
+    p = load / jnp.maximum(jnp.sum(load, axis=-1, keepdims=True), 1e-9)
+    ent = -jnp.sum(p * jnp.log(p + 1e-9), axis=-1)
+    return jnp.mean(ent) / jnp.log(jnp.float32(e))
+
+
+def sown_scalar_mean(collection: Any, name: str) -> Any:
+    """Mean of every value sown under key ``name`` anywhere inside a
+    nested flax collection dict (flax stores sows as tuples).
+
+    Returns an f32 0-d array; 0.0 when nothing was sown — so callers
+    can keep their metrics dict static across module configurations.
+    """
+    import jax.numpy as jnp
+
+    vals: list[Any] = []
+
+    def walk(node: Any) -> None:
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                if k == name:
+                    for item in v if isinstance(v, (tuple, list)) else (v,):
+                        vals.append(jnp.mean(item.astype(jnp.float32)))
+                else:
+                    walk(v)
+
+    walk(collection)
+    if not vals:
+        return jnp.float32(0.0)
+    return sum(vals[1:], vals[0]) / len(vals)
+
+
+# ---------------------------------------------------------------------------
+# Host-side helpers
+# ---------------------------------------------------------------------------
+
+
+def speculative_accept_rate(
+    new_tokens: int, target_calls: int, k: int
+) -> float | None:
+    """Realized draft-acceptance rate of a speculative-decode run.
+
+    Each target call scores one draft block and always yields >=1
+    token; accepted drafts yield the rest. With ``k`` drafted tokens
+    per block: rate = (new_tokens / target_calls - 1) / k.
+    """
+    if target_calls <= 0 or k <= 0:
+        return None
+    rate = (new_tokens / target_calls - 1.0) / k
+    return max(0.0, min(1.0, rate))
+
+
+class Telemetry:
+    """The one object engines talk to.
+
+    Always keeps a :class:`RingSink` (the watchdog flushes its tail on
+    hang, and tests read it); adds a rank-0 JSONL file only when
+    ``metrics_dir`` is set. ``due(step)`` is the emission gate the
+    engines check *at their existing fetch points* — Telemetry never
+    initiates a device fetch itself.
+    """
+
+    def __init__(
+        self,
+        metrics_dir: str | None = None,
+        every: int = 1,
+        run: str = "train",
+        *,
+        ring_capacity: int = 256,
+        system_every: int = 5,  # system record per N step emissions; 0 = off
+        flops_per_step: float | None = None,
+        n_chips: int = 1,
+        device_kind: str | None = None,
+        extra_sinks: Iterable[MetricSink] = (),
+    ):
+        self.metrics_dir = metrics_dir
+        self.every = max(1, int(every))
+        self.run = run
+        self.flops_per_step = flops_per_step
+        self.n_chips = max(1, int(n_chips))
+        self.device_kind = device_kind
+        self.ring = RingSink(ring_capacity)
+        sinks: list[MetricSink] = [self.ring, *extra_sinks]
+        self.path: str | None = None
+        if metrics_dir is not None:
+            os.makedirs(metrics_dir, exist_ok=True)
+            self.path = os.path.join(metrics_dir, METRICS_NAME)
+            sinks.append(rank_zero(JsonlSink(self.path)))
+        self._sink = MultiSink(sinks)
+        self._system = _system.SystemMonitor()
+        self._system_every = max(0, int(system_every))
+        self._emits = 0
+        self._last_step: int | None = None
+        self._last_mono: float | None = None
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def write_manifest(
+        self, config: Any = None, mesh: Any = None, **extra: Any
+    ) -> str | None:
+        """Write ``manifest.json`` beside the metrics (no-op without a
+        ``metrics_dir``; rank-gated inside)."""
+        if self.metrics_dir is None:
+            return None
+        return _run_manifest.write_manifest(
+            self.metrics_dir, config=config, mesh=mesh, run=self.run, **extra
+        )
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._sink.close()
+
+    # -- emission ----------------------------------------------------------
+
+    def due(self, step: int) -> bool:
+        """Should the engine emit (and therefore fetch) at this step?"""
+        return step % self.every == 0
+
+    def emit_step(self, step: int, **fields: Any) -> None:
+        """Emit one per-step record. ``step_time_s`` is amortized over
+        the steps elapsed since the previous emission, so any cadence
+        still yields an honest per-step time; MFU derives from it when
+        the engine declared ``flops_per_step`` on a known TPU."""
+        now = time.monotonic()
+        record: dict[str, Any] = {
+            "kind": "step",
+            "run": self.run,
+            "step": int(step),
+            "time": time.time(),
+        }
+        step_time = None
+        if self._last_mono is not None and self._last_step is not None:
+            dsteps = int(step) - self._last_step
+            if dsteps > 0:
+                step_time = (now - self._last_mono) / dsteps
+        self._last_mono, self._last_step = now, int(step)
+        record["step_time_s"] = step_time
+        if step_time and self.flops_per_step:
+            record["mfu"] = _flops.mfu(
+                self.flops_per_step / step_time / self.n_chips,
+                self.device_kind or "",
+            )
+        record.update(fields)
+        self._sink.emit(record)
+        self._emits += 1
+        if self._system_every and self._emits % self._system_every == 0:
+            self.emit_system(step)
+
+    def emit_system(self, step: int | None = None) -> None:
+        record: dict[str, Any] = {
+            "kind": "system",
+            "run": self.run,
+            "time": time.time(),
+        }
+        if step is not None:
+            record["step"] = int(step)
+        record.update(self._system.snapshot())
+        self._sink.emit(record)
+
+    def emit_event(self, event: str, **fields: Any) -> None:
+        record: dict[str, Any] = {
+            "kind": "event",
+            "run": self.run,
+            "event": event,
+            "time": time.time(),
+        }
+        record.update(fields)
+        self._sink.emit(record)

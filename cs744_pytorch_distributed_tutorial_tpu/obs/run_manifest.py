@@ -1,0 +1,156 @@
+"""Run-start manifests.
+
+A metrics JSONL on its own is a pile of numbers; the manifest written
+next to it (``manifest.json``) is what makes the stream
+self-describing: the exact config dataclass, mesh shape, device
+kind/count, jax/jaxlib versions, and — when the repo is a git
+checkout — the commit SHA. Post-mortems and benchmark sweeps join on
+this file, never on directory-naming conventions.
+
+Only process 0 writes on multihost (same replicated information on
+every host), and the write is atomic (tmp + ``os.replace``) so a
+crash mid-run never leaves a half-written manifest beside a valid
+metrics file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import os
+import platform
+import subprocess
+import sys
+from typing import Any, Mapping
+
+__all__ = ["build_manifest", "write_manifest", "read_manifest"]
+
+MANIFEST_NAME = "manifest.json"
+
+
+def _git_sha() -> str | None:
+    """Commit SHA of the repo this package lives in, or None when not
+    a git checkout / git absent (installed wheels, containers)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=5,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def _config_dict(config: Any) -> Any:
+    if config is None:
+        return None
+    if dataclasses.is_dataclass(config) and not isinstance(config, type):
+        raw = dataclasses.asdict(config)
+        # Keep the manifest strict-JSON: tuples become lists via json,
+        # but exotic leaves (dtypes, paths) need a str fallback.
+        return json.loads(json.dumps(raw, default=str))
+    if isinstance(config, Mapping):
+        return json.loads(json.dumps(dict(config), default=str))
+    return str(config)
+
+
+def _mesh_dict(mesh: Any) -> dict[str, int] | None:
+    if mesh is None:
+        return None
+    try:
+        return {str(name): int(size) for name, size in mesh.shape.items()}
+    except (AttributeError, TypeError):
+        return None
+
+
+def build_manifest(
+    config: Any = None, mesh: Any = None, **extra: Any
+) -> dict[str, Any]:
+    """Assemble the manifest dict. Everything is best-effort: a
+    manifest with a null field beats a run with no manifest."""
+    import jax
+
+    try:
+        devices = jax.devices()
+        device_kind = devices[0].device_kind
+        backend = jax.default_backend()
+        n_devices = len(devices)
+        n_local = jax.local_device_count()
+    except RuntimeError:
+        devices, device_kind, backend, n_devices, n_local = [], None, None, 0, 0
+    try:
+        n_processes = jax.process_count()
+        process_index = jax.process_index()
+    except RuntimeError:
+        n_processes, process_index = 1, 0
+
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", None)
+    except ImportError:
+        jaxlib_version = None
+
+    manifest: dict[str, Any] = {
+        "kind": "manifest",
+        "created_at": datetime.datetime.now(datetime.timezone.utc).isoformat(),
+        "argv": list(sys.argv),
+        "python_version": platform.python_version(),
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "backend": backend,
+        "device_kind": device_kind,
+        "device_count": n_devices,
+        "local_device_count": n_local,
+        "process_count": n_processes,
+        "process_index": process_index,
+        "hostname": platform.node(),
+        "git_sha": _git_sha(),
+        "mesh": _mesh_dict(mesh),
+        "config": _config_dict(config),
+    }
+    manifest.update(extra)
+    return manifest
+
+
+def write_manifest(
+    path: str, config: Any = None, mesh: Any = None, **extra: Any
+) -> str | None:
+    """Write ``manifest.json`` under directory ``path`` (or to ``path``
+    itself when it ends in .json). Returns the file path, or None on
+    non-zero ranks. Atomic so readers never see a torn file."""
+    import jax
+
+    try:
+        if jax.process_index() != 0:
+            return None
+    except RuntimeError:
+        pass  # backend not up yet: single-process, write away
+
+    if path.endswith(".json"):
+        target = path
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+        target = os.path.join(path, MANIFEST_NAME)
+    manifest = build_manifest(config=config, mesh=mesh, **extra)
+    tmp = target + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=2, default=str)
+        f.write("\n")
+    os.replace(tmp, target)
+    return target
+
+
+def read_manifest(path: str) -> dict[str, Any]:
+    """Load a manifest from a file or from the directory holding it."""
+    if os.path.isdir(path):
+        path = os.path.join(path, MANIFEST_NAME)
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)

@@ -370,3 +370,29 @@ def sync_grads_compressed(
         means.append(mean.astype(dtype))
         residuals.append(resid)
     return B.unflatten(means, layout), B.unflatten(residuals, layout)
+
+
+def sync_wire_bytes(
+    params,
+    name: str,
+    axis_size: int,
+    grad_compress: str = "none",
+    *,
+    quant_chunk: int = QUANT_CHUNK,
+) -> int:
+    """Per-step gradient-sync payload bytes of the ACTIVE configuration.
+
+    This is the strategy's own accounting (``buckets.sync_bytes_per_step``)
+    resolved through the same knobs the engines resolve: ``name`` is the
+    ``cfg.sync`` strategy, and ``grad_compress="int8"`` reroutes the wire
+    math to the quantized payload regardless of the base strategy —
+    exactly what ``sync_grads_compressed`` does to the collectives. The
+    telemetry layer records this number as ``grad_sync_bytes`` per step.
+    """
+    if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
+        strategy = "int8_ring" if name in ("ring", "int8_ring") else "int8_allreduce"
+    else:
+        strategy = name
+    return B.sync_bytes_per_step(
+        params, strategy, axis_size, quant_chunk=quant_chunk
+    )

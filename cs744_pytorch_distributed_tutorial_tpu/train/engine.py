@@ -40,6 +40,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from cs744_pytorch_distributed_tutorial_tpu import compat
 from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
 from cs744_pytorch_distributed_tutorial_tpu.data import BatchLoader, load_cifar10
 from cs744_pytorch_distributed_tutorial_tpu.data.augment import (
@@ -56,16 +57,22 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     make_mesh,
     replicated,
 )
+from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
+    Telemetry,
+    tree_l2_norm,
+)
 from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
     UNCHECKED_REPLICATION,
     get_sync,
     sync_grads,
     sync_grads_compressed,
+    sync_wire_bytes,
 )
 from cs744_pytorch_distributed_tutorial_tpu.train.state import (
     TrainState,
     init_state,
     make_optimizer,
+    make_schedule,
 )
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
 from cs744_pytorch_distributed_tutorial_tpu.utils.timing import StepTimer
@@ -299,6 +306,13 @@ class Trainer:
         self._check_vma = (
             cfg.sync not in UNCHECKED_REPLICATION and not self._compress
         )
+        if compat.LEGACY_SHARD_MAP and cfg.accum_steps > 1:
+            # Old shard_map's scan replication rule rejects literal
+            # (jnp.zeros) accumulator carries with a rep-type mismatch.
+            # Checking off is safe here: with accum the grads are synced
+            # explicitly inside each microbatch, never via AD-inserted
+            # collectives.
+            self._check_vma = False
         if cfg.hang_action not in ("log", "abort"):
             raise ValueError(
                 f"unknown hang_action {cfg.hang_action!r}; choose 'log' or 'abort'"
@@ -358,7 +372,17 @@ class Trainer:
         #    device-varying first, so grads come out purely LOCAL (the state
         #    after the reference's loss.backward() and before its sync
         #    loop), then the strategy's explicit collectives average them.
-        framework_inserted_sync = cfg.sync in ("auto", "none")
+        # On legacy jax (compat shims active) the old replication checker
+        # cannot follow AD-inserted collectives, and with checking off the
+        # old psum transpose rule returns unaveraged gradients — so
+        # 'auto'/'none' reroute through the explicit path with a pmean,
+        # which is numerically identical to what vma-aware AD inserts.
+        framework_inserted_sync = (
+            cfg.sync in ("auto", "none") and not compat.LEGACY_SHARD_MAP
+        )
+        explicit_sync = (
+            "allreduce" if cfg.sync in ("auto", "none") else cfg.sync
+        )
 
         # fsdp needs the ORIGINAL param shapes to unshard its flat chunks
         # (zero.py FsdpSGD.gather_params); abstract init gives them without
@@ -415,7 +439,7 @@ class Trainer:
                 if not self._compress:
                     grads = sync_grads(
                         grads,
-                        cfg.sync,
+                        explicit_sync,
                         DATA_AXIS,
                         axis_size,
                         bucket_bytes=self._bucket_bytes,
@@ -534,6 +558,14 @@ class Trainer:
                 "loss": loss,  # global mean for logging
                 "local_loss": local_loss[None],  # [1]/replica -> [axis_size]
             }
+            if obs_norms:
+                # Telemetry scalars, computed ON DEVICE where the trees
+                # already live; the host sees them only at the logging-
+                # cadence fetch. grads here are the post-sync (globally
+                # averaged) gradients, so the norm is the true global
+                # gradient norm; new_params are replicated.
+                metrics["grad_norm"] = tree_l2_norm(grads)
+                metrics["param_norm"] = tree_l2_norm(new_params)
             new_state = TrainState(
                 step=state.step + 1,
                 params=new_params,
@@ -543,8 +575,17 @@ class Trainer:
             )
             return new_state, metrics
 
+        # zero1/fsdp never materialize the synced gradient tree (the
+        # averaging is fused into the sharded update), so a global grad/
+        # param norm would be either wrong or an extra collective — those
+        # layouts omit the norm metrics rather than fabricate them.
+        obs_norms = not (self._zero1 or self._fsdp)
+        self._obs_norms = obs_norms
+
         state_specs = self._state_specs()
         metric_specs = {"loss": P(), "local_loss": P(DATA_AXIS)}
+        if obs_norms:
+            metric_specs.update({"grad_norm": P(), "param_norm": P()})
 
         mapped_train = jax.shard_map(
             local_train_step,
@@ -574,6 +615,8 @@ class Trainer:
             return lax.scan(body, state, (images, labels))
 
         scan_metric_specs = {"loss": P(), "local_loss": P(None, DATA_AXIS)}
+        if obs_norms:
+            scan_metric_specs.update({"grad_norm": P(), "param_norm": P()})
         mapped_scan = jax.shard_map(
             local_train_scan,
             mesh=self.mesh,
@@ -700,6 +743,46 @@ class Trainer:
             jax.random.key(cfg.seed), replicated(self.mesh)
         )
 
+        # ---- telemetry (obs/): the in-memory ring always exists (the
+        # watchdog flushes it post-mortem); manifest + JSONL only when
+        # cfg.metrics_dir is set. Emission is gated on the SAME fetch the
+        # logging/timing path already performs — zero extra round-trips.
+        flops_per_step = None
+        if cfg.model == "resnet18":
+            from cs744_pytorch_distributed_tutorial_tpu.obs.flops import (
+                resnet18_cifar_train_flops_per_sample,
+            )
+
+            flops_per_step = (
+                resnet18_cifar_train_flops_per_sample() * cfg.global_batch_size
+            )
+        # Analytic bytes-on-wire of the active sync config, recorded on
+        # every step record. Non-compressed strategies sync once per
+        # MICROBATCH under gradient accumulation; the compressed path
+        # syncs the accumulated gradient once, and zero1 fuses its
+        # reduce-scatter into the single sharded update.
+        syncs_per_step = 1 if (self._compress or self._zero1) else cfg.accum_steps
+        wire_bytes = syncs_per_step * sync_wire_bytes(
+            state.params, cfg.sync, self.axis_size, cfg.grad_compress
+        )
+        sched = make_schedule(cfg)
+        lr_at = (
+            (lambda s: float(sched))
+            if isinstance(sched, (int, float))
+            else (lambda s: float(sched(s)))
+        )
+        telemetry = Telemetry(
+            cfg.metrics_dir,
+            every=cfg.metrics_every or cfg.log_every,
+            run="cifar",
+            flops_per_step=flops_per_step,
+            n_chips=int(self.mesh.devices.size),
+            device_kind=jax.devices()[0].device_kind,
+        )
+        telemetry.write_manifest(
+            config=cfg, mesh=self.mesh, grad_sync_bytes_per_step=wire_bytes
+        )
+
         history: dict[str, Any] = {"train_loss": [], "eval": [], "avg_batch_time": None}
         timer = StepTimer(window=cfg.timing_batches)
         ckpt = None
@@ -740,7 +823,12 @@ class Trainer:
                 def on_hang(elapsed_s: float) -> None:
                     os._exit(13)
 
-            watchdog = StepWatchdog(cfg.step_timeout_s, on_hang=on_hang)
+            # The watchdog gets the telemetry ring: on firing it flushes
+            # the last step records so the post-mortem shows WHAT the run
+            # was doing, not just where the host is blocked.
+            watchdog = StepWatchdog(
+                cfg.step_timeout_s, on_hang=on_hang, metric_ring=telemetry.ring
+            )
         if cfg.halt_on_nonfinite:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
                 NonFiniteLossError,
@@ -859,17 +947,47 @@ class Trainer:
                     # environment's tunneled TPU backend (see bench.py).
                     timing_active = timer.steps_recorded <= cfg.timing_batches[1]
                     should_log = batch_idx % cfg.log_every == 0
+                    metrics_due = telemetry.due(steps_done)
                     checkpoint_due = bool(
                         ckpt
                         and cfg.checkpoint_every
                         and (steps_done + 1) % cfg.checkpoint_every == 0
                     )
-                    if timing_active or should_log or pending_ckpt is not None:
+                    if (
+                        timing_active
+                        or should_log
+                        or metrics_due
+                        or pending_ckpt is not None
+                    ):
                         loss = float(metrics["loss"])
                         if watchdog is not None:
                             watchdog.disarm()  # the fetch is the hang point
                         if cfg.halt_on_nonfinite and not math.isfinite(loss):
+                            telemetry.emit_event(
+                                "non_finite_loss", step=steps_done, loss=loss
+                            )
                             raise NonFiniteLossError(steps_done, loss)
+                        if metrics_due:
+                            obs_fields = {}
+                            if self._obs_norms:
+                                # Same fetch boundary as the loss: the
+                                # device work is already fenced, these are
+                                # ready scalars.
+                                obs_fields["grad_norm"] = float(
+                                    metrics["grad_norm"]
+                                )
+                                obs_fields["param_norm"] = float(
+                                    metrics["param_norm"]
+                                )
+                            telemetry.emit_step(
+                                steps_done,
+                                loss=loss,
+                                epoch=epoch,
+                                batch=batch_idx,
+                                lr=lr_at(steps_done),
+                                grad_sync_bytes=wire_bytes,
+                                **obs_fields,
+                            )
                         if pending_ckpt is not None and steps_done == pending_ckpt[0]:
                             # this loss is the forward pass over the pending
                             # state's params — certified finite, persist it
@@ -899,11 +1017,27 @@ class Trainer:
                         else:
                             guarded_save(state)
                 if self.sync_monitor is not None:
-                    # Epoch boundary: fence in-flight debug callbacks and fail
-                    # loudly if any replica drifted (utils/debug.py).
+                    # Epoch boundary: fence in-flight debug callbacks, put
+                    # the verdict on the metric stream, and fail loudly if
+                    # any replica drifted (utils/debug.py).
+                    divergent = self.sync_monitor.divergent_steps()
+                    telemetry.emit_event(
+                        "divergence_check",
+                        epoch=epoch,
+                        steps_checked=self.sync_monitor.steps_recorded,
+                        divergent_steps=len(divergent),
+                        in_sync=not divergent,
+                    )
                     self.sync_monitor.assert_in_sync()
                 eval_metrics = self.evaluate(state, test_loader, watchdog=watchdog)
                 history["eval"].append(eval_metrics)
+                telemetry.emit_event(
+                    "eval",
+                    epoch=epoch,
+                    step=steps_done,
+                    avg_loss=eval_metrics["avg_loss"],
+                    accuracy=eval_metrics["accuracy"],
+                )
                 self.log.info(
                     "Test set: Average loss: %.4f, Accuracy: %d/%d (%.0f%%)",
                     eval_metrics["avg_loss"],
@@ -943,6 +1077,7 @@ class Trainer:
                 watchdog.close()
             if ckpt is not None:
                 ckpt.close()
+            telemetry.close()
         return state, history
 
     def evaluate_only(self, dataset=None) -> dict[str, float]:

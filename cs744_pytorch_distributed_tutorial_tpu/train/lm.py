@@ -26,6 +26,11 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from cs744_pytorch_distributed_tutorial_tpu.config import resolve_dtype
+from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
+    Telemetry,
+    sown_scalar_mean,
+    tree_l2_norm,
+)
 from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
     ATTENTION_IMPLS,
     TransformerLM,
@@ -270,6 +275,12 @@ class LMConfig:
     # hang watchdog around each step (first step exempt: XLA compile).
     halt_on_nonfinite: bool = True
     step_timeout_s: float | None = None
+
+    # Telemetry (obs/), same contract as TrainConfig: metrics_dir writes
+    # manifest.json + metrics.jsonl. fit() fetches every loss already,
+    # so the default cadence is every step — still zero extra transfers.
+    metrics_dir: str | None = None
+    metrics_every: int = 1
 
     # Profiler capture (utils/profiling.py), same contract as the CIFAR
     # engine: trace steps [profile_start_step, + profile_num_steps) to
@@ -884,11 +895,14 @@ class LMTrainer:
                 )
 
                 aux = moe_aux_loss(mut)
-                drops = jax.tree_util.tree_leaves(mut.get("metrics", {}))
-                drop = (
-                    sum(drops) / len(drops) if drops else jnp.float32(0.0)
-                )
-                return ce + aux_coef * aux, (aux, drop)
+                # Name-filtered collection: the "metrics" collection now
+                # carries more than the drop rate (each MoE layer also
+                # sows its expert-load entropy), so averaging ALL leaves
+                # would mix the two.
+                sown = mut.get("metrics", {})
+                drop = sown_scalar_mean(sown, "moe_drop")
+                ent = sown_scalar_mean(sown, "moe_load_entropy")
+                return ce + aux_coef * aux, (aux, drop, ent)
 
             def diff_loss(p_or_chunks, toks, tgts, key):
                 # FSDP differentiates THROUGH the just-in-time unshard:
@@ -912,7 +926,7 @@ class LMTrainer:
             # Equal token counts per shard make pmean of local means the
             # exact global mean.
             if accum == 1:
-                (local_loss, (aux, drop)), grads = jax.value_and_grad(
+                (local_loss, (aux, drop, ent)), grads = jax.value_and_grad(
                     diff_loss, has_aux=True
                 )(params, tokens, targets, drop_base)
             else:
@@ -924,8 +938,8 @@ class LMTrainer:
                 mb_keys = jax.random.split(drop_base, accum)
 
                 def body(carry, mb):
-                    g_sum, l_sum, a_sum, d_sum = carry
-                    (l, (a, dr)), g = jax.value_and_grad(
+                    g_sum, l_sum, a_sum, d_sum, e_sum = carry
+                    (l, (a, dr, en)), g = jax.value_and_grad(
                         diff_loss, has_aux=True
                     )(params, mb[0], mb[1], mb[2])
                     return (
@@ -933,16 +947,18 @@ class LMTrainer:
                         l_sum + l,
                         a_sum + a,
                         d_sum + dr,
+                        e_sum + en,
                     ), None
 
                 zeros = jax.tree.map(jnp.zeros_like, params)
                 z = jnp.zeros((), jnp.float32)
-                (g_sum, l_sum, a_sum, d_sum), _ = lax.scan(
-                    body, (zeros, z, z, z), (mb_tok, mb_tgt, mb_keys)
+                (g_sum, l_sum, a_sum, d_sum, e_sum), _ = lax.scan(
+                    body, (zeros, z, z, z, z), (mb_tok, mb_tgt, mb_keys)
                 )
                 grads = jax.tree.map(lambda g: g / accum, g_sum)
                 local_loss = l_sum / accum
                 aux, drop = a_sum / accum, d_sum / accum
+                ent = e_sum / accum
             loss = mean_over_replicas(local_loss)
             if zero1_opt is not None:
                 # ZeRO-1 consumes the RAW local grads: its per-leaf
@@ -980,17 +996,30 @@ class LMTrainer:
             if compress:
                 opt_state = (opt_state, ef)
             metrics = {"loss": loss}
+            if zero1_opt is None:
+                # Telemetry norms, on device at the trees' native
+                # sharding: spec-aware psums give the GLOBAL norms
+                # (tensor/expert-sharded leaves summed over their axes,
+                # replicated leaves counted once). zero1/fsdp omit them —
+                # the synced gradient tree never materializes there.
+                metrics["grad_norm"] = tree_l2_norm(grads, param_specs)
+                metrics["param_norm"] = tree_l2_norm(params, param_specs)
             if moe_on:
                 # MoE observability (VERDICT r3 #6): the load-balancing
-                # aux term and the capacity-overflow drop rate, averaged
-                # over replicas like the loss.
+                # aux term, the capacity-overflow drop rate, and the
+                # expert-load entropy, averaged over replicas like the loss.
                 metrics["moe_aux"] = mean_over_replicas(aux)
                 metrics["moe_drop"] = mean_over_replicas(drop)
+                metrics["moe_load_entropy"] = mean_over_replicas(ent)
             return params, opt_state, metrics
 
         metric_specs = {"loss": P()}
+        if zero1_opt is None:
+            metric_specs.update({"grad_norm": P(), "param_norm": P()})
         if moe_on:
-            metric_specs.update({"moe_aux": P(), "moe_drop": P()})
+            metric_specs.update(
+                {"moe_aux": P(), "moe_drop": P(), "moe_load_entropy": P()}
+            )
         mapped_step = jax.jit(
             jax.shard_map(
                 local_step,
@@ -1136,13 +1165,71 @@ class LMTrainer:
         self.history: dict[str, list[float]] = {"loss": losses}
         n = len(tokens)
         b = cfg.global_batch_size
+
+        # ---- telemetry (obs/): ring always (watchdog post-mortems),
+        # manifest + JSONL when cfg.metrics_dir is set. fit() fetches
+        # every metric scalar per step already (losses/history), so
+        # emission adds no transfers.
+        from cs744_pytorch_distributed_tutorial_tpu.obs.flops import (
+            transformer_train_flops_per_token,
+        )
+        from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+            sync_wire_bytes,
+        )
+        from cs744_pytorch_distributed_tutorial_tpu.train.state import (
+            make_schedule,
+        )
+
+        n_params = sum(
+            int(l.size) for l in jax.tree_util.tree_leaves(params)
+        )
+        # Data-parallel gradient-sync bytes of the active layout; the
+        # tensor/seq-axis collectives (activations, f/g boundaries) are
+        # deliberately out of scope — this ledger tracks the DP wire the
+        # compression strategies target.
+        if self._compress:
+            dp_strategy = "int8_allreduce"
+        elif cfg.fsdp:
+            dp_strategy = "fsdp"
+        elif self._zero1_opt is not None:
+            dp_strategy = "zero1"
+        else:
+            dp_strategy = "allreduce"
+        wire_bytes = sync_wire_bytes(params, dp_strategy, self.data_size)
+        sched = make_schedule(cfg)
+        lr_at = (
+            (lambda s: float(sched))
+            if isinstance(sched, (int, float))
+            else (lambda s: float(sched(s)))
+        )
+        telemetry = Telemetry(
+            cfg.metrics_dir,
+            every=cfg.metrics_every,
+            run="lm",
+            flops_per_step=(
+                transformer_train_flops_per_token(n_params)
+                * b
+                * cfg.seq_len
+            ),
+            n_chips=int(self.mesh.devices.size),
+            device_kind=jax.devices()[0].device_kind,
+        )
+        telemetry.write_manifest(
+            config=cfg,
+            mesh=self.mesh,
+            n_params=n_params,
+            grad_sync_bytes_per_step=wire_bytes,
+        )
+
         watchdog = None
         if cfg.step_timeout_s:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
                 StepWatchdog,
             )
 
-            watchdog = StepWatchdog(cfg.step_timeout_s)
+            watchdog = StepWatchdog(
+                cfg.step_timeout_s, metric_ring=telemetry.ring
+            )
         if cfg.halt_on_nonfinite:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
                 NonFiniteLossError,
@@ -1199,17 +1286,29 @@ class LMTrainer:
                 ):
                     stop_profile()
                 if cfg.halt_on_nonfinite and not math.isfinite(loss):
+                    telemetry.emit_event(
+                        "non_finite_loss", step=step, loss=loss
+                    )
                     raise NonFiniteLossError(step, loss)
                 if pending_ckpt is not None:
                     # This finite loss ran over pending_ckpt's params.
                     ckpt.save(pending_ckpt)
                     pending_ckpt = None
                 losses.append(loss)
+                step_fields: dict[str, float] = {}
                 for key in m:
                     if key != "loss":
-                        self.history.setdefault(key, []).append(
-                            float(m[key])
-                        )
+                        val = float(m[key])
+                        step_fields[key] = val
+                        self.history.setdefault(key, []).append(val)
+                if telemetry.due(step):
+                    telemetry.emit_step(
+                        step,
+                        loss=loss,
+                        lr=lr_at(step),
+                        grad_sync_bytes=wire_bytes,
+                        **step_fields,
+                    )
                 if (
                     ckpt
                     and cfg.checkpoint_every
@@ -1246,4 +1345,5 @@ class LMTrainer:
                 watchdog.close()
             if ckpt is not None:
                 ckpt.close()
+            telemetry.close()
         return params, opt_state, losses

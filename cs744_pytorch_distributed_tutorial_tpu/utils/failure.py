@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import contextlib
 import faulthandler
+import json
 import sys
 import threading
 import time
@@ -83,10 +84,19 @@ class StepWatchdog:
         timeout_s: float,
         on_hang: Callable[[float], None] | None = None,
         dump_stacks: bool = True,
+        metric_ring: Any | None = None,
+        ring_tail: int = 32,
     ):
         self.timeout_s = timeout_s
         self.on_hang = on_hang
         self.dump_stacks = dump_stacks
+        # Any object with .tail(n) -> list[dict] (obs.sinks.RingSink):
+        # on firing, the last N step records are flushed to the log so
+        # the operator sees what the run was doing when it wedged —
+        # stacks say WHERE the host is stuck, the ring says WHAT the
+        # training was converging (or not) toward.
+        self.metric_ring = metric_ring
+        self.ring_tail = ring_tail
         self.fired = 0  # total hang detections (for tests/metrics)
         self._log = get_logger()
         self._cv = threading.Condition()
@@ -161,6 +171,18 @@ class StepWatchdog:
         )
         if self.dump_stacks:
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        if self.metric_ring is not None:
+            try:
+                records = self.metric_ring.tail(self.ring_tail)
+            except Exception as e:  # never let telemetry break the report
+                self._log.critical("watchdog: metric ring unreadable: %r", e)
+                records = []
+            if records:
+                self._log.critical(
+                    "watchdog: last %d metric records before hang:", len(records)
+                )
+                for rec in records:
+                    self._log.critical("watchdog:   %s", json.dumps(rec, default=str))
         if self.on_hang is not None:
             self.on_hang(elapsed_s)
 

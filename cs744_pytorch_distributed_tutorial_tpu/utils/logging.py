@@ -16,16 +16,32 @@ from functools import wraps
 
 import jax
 
+class _RankPrefixFilter(logging.Filter):
+    """Stamp each record with the CURRENT ``[proc i/n]`` prefix.
+
+    The prefix must be computed per-record, not cached at handler
+    creation: loggers are routinely created at import time, before
+    ``jax.distributed`` initializes, and a cached prefix would then be
+    silently wrong (absent) for the rest of the run.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        try:
+            n = jax.process_count()
+            record.rank_prefix = (
+                f"[proc {jax.process_index()}/{n}] " if n > 1 else ""
+            )
+        except RuntimeError:  # backend not up yet
+            record.rank_prefix = ""
+        return True
+
+
 def get_logger(name: str = "cs744_tpu") -> logging.Logger:
     logger = logging.getLogger(name)
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stdout)
-        prefix = (
-            f"[proc {jax.process_index()}/{jax.process_count()}] "
-            if jax.process_count() > 1
-            else ""
-        )
-        handler.setFormatter(logging.Formatter(f"{prefix}%(message)s"))
+        handler.addFilter(_RankPrefixFilter())
+        handler.setFormatter(logging.Formatter("%(rank_prefix)s%(message)s"))
         logger.addHandler(handler)
         logger.setLevel(logging.INFO)
         logger.propagate = False

@@ -33,6 +33,7 @@ def _vit_losses(mesh4, accum, steps=3):
     return losses
 
 
+@pytest.mark.slow
 def test_accum_matches_unaccumulated_without_bn(mesh4):
     """ViT has no BatchNorm, so accumulation is numerically invisible (up
     to summation order): the loss trajectory must match accum=1."""
@@ -42,6 +43,7 @@ def test_accum_matches_unaccumulated_without_bn(mesh4):
 
 
 @pytest.mark.parametrize("sync", ["allreduce", "zero1", "fsdp"])
+@pytest.mark.slow
 def test_accum_trains_under_each_strategy_family(mesh4, sync):
     """Accumulation composes with the manual, ZeRO-1, and ZeRO-3 paths
     (BN present: trajectories differ from accum=1, but training is sound)."""

@@ -172,6 +172,7 @@ def test_dropless_rejects_expert_parallel():
         LMTrainer(cfg, mesh=mesh)
 
 
+@pytest.mark.slow
 def test_dropless_lm_trains():
     """A 2-device data-parallel dropless-MoE LM learns the cyclic
     synthetic stream (the end-to-end descent check the other dispatch

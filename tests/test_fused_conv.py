@@ -15,9 +15,13 @@ import pytest
 from jax import lax
 
 from cs744_pytorch_distributed_tutorial_tpu.ops.fused_conv import (
+
     conv3x3,
     conv3x3_wgrad,
 )
+
+# CPU-interpret Pallas conv parity: minutes of XLA compile per case.
+pytestmark = pytest.mark.slow
 
 
 def _ref_wgrad(x, g, stride):

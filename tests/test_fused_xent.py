@@ -10,6 +10,9 @@ from cs744_pytorch_distributed_tutorial_tpu.ops.fused_xent import (
     fused_cross_entropy,
 )
 
+# CPU-interpret Pallas xent kernels: heavy compile.
+pytestmark = pytest.mark.slow
+
 
 @pytest.mark.parametrize(
     "n,v",

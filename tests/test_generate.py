@@ -139,6 +139,7 @@ def test_generation_rejects_overlong_request(tiny_lm):
         generate(params, prompt, jax.random.key(0))
 
 
+@pytest.mark.slow
 def test_decode_model_generates_from_seq_parallel_training():
     """The full user journey: train on a data x seq mesh with ring
     attention, then generate from the SAME params via

@@ -13,7 +13,12 @@ numeric drift without masking real changes.
 """
 
 import numpy as np
+import pytest
 from conftest import run_tiny_dp4_steps
+
+# Full engine fit per case — heavy compile; the curve is also pinned to
+# the new-jax AD-inserted-sync path, which the compat shim reroutes.
+pytestmark = pytest.mark.slow
 
 # Recorded on the 8-virtual-CPU-device harness (4-device data mesh),
 # tiny_cnn, sync="auto", global batch 32, synthetic CIFAR seed 5000,

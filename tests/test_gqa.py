@@ -63,6 +63,7 @@ def test_gqa_rejects_indivisible_heads():
             model.init(jax.random.key(0), jnp.zeros((1, 4), jnp.int32))
 
 
+@pytest.mark.slow
 def test_gqa_ring_rotates_kv_width_and_matches_dense():
     """ring/ring_flash accept kv-width K/V (blocks rotate at kv heads —
     the ICI saving) and match dense attention on repeated heads, forward

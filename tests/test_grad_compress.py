@@ -200,6 +200,7 @@ def test_int8_ef_sgd_converges_like_f32(mesh4):
     assert got[-1] < got[0]
 
 
+@pytest.mark.slow
 def test_int8_short_run_stays_close(mesh4):
     """Fast (tier-1) version of the convergence check: 8 steps, 2%."""
     ref, _, _ = run_tiny_dp4_steps("allreduce", mesh4, steps=8)

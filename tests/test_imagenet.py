@@ -63,6 +63,7 @@ def test_synthetic_cifar10_unchanged_by_generalization():
     assert ds.train_labels.tolist() == [5, 0, 0, 9, 1, 2, 1, 4]
 
 
+@pytest.mark.slow
 def test_imagenet_shaped_training_end_to_end(mesh4):
     """ResNet-18 with the ImageNet stem at 64x64/20 classes trains under
     DP allreduce: finite, decreasing-ish loss, eval runs."""

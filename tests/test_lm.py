@@ -30,6 +30,7 @@ def test_transformer_forward_shape():
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_lm_training_learns_seq_parallel(impl):
     """data=2 x seq=4 mesh; loss on the cyclic synthetic stream must drop
     well below the uniform baseline log(vocab)."""
@@ -106,6 +107,7 @@ def test_dense_attention_with_seq_parallel_rejected():
                   mesh=make_mesh({"data": 2, "seq": 4}))
 
 
+@pytest.mark.slow
 def test_tied_embeddings_drop_lm_head_and_train():
     """tie_embeddings removes lm_head from the tree (vocab params halved),
     the tied logits equal x @ E^T, and training/generation still run."""
@@ -153,6 +155,7 @@ def test_evaluate_returns_perplexity():
         tr.evaluate(params, tokens[:2])
 
 
+@pytest.mark.slow
 def test_lm_optimizer_registry():
     """LMConfig rides the shared optimizer/schedule registry: warmup-
     cosine AdamW and SGD both train; trajectories differ."""
@@ -177,6 +180,7 @@ def test_lm_optimizer_registry():
     assert any(not np.allclose(x, y) for x, y in zip(a, b))
 
 
+@pytest.mark.slow
 def test_grad_clip_changes_trajectory_and_stays_replicated():
     """Clipped AdamW runs the distributed step; a binding bound changes
     the trajectory; params remain replicated (the clip factor must be

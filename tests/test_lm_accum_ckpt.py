@@ -23,6 +23,7 @@ def _mesh24():
     return make_mesh({"data": 2, "seq": 4})
 
 
+@pytest.mark.slow
 def test_accum_matches_unaccumulated():
     """accum_steps=2 over the same global batch: same loss curve and final
     params as accum_steps=1 (mean of microbatch means == full-batch mean
@@ -41,9 +42,11 @@ def test_accum_matches_unaccumulated():
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
     # Params: microbatch summation order differs from the fused reduction,
     # and adamw's second-moment normalization amplifies those float32
-    # last-bit differences — tolerance reflects numerical noise, not drift.
+    # last-bit differences — tolerance reflects numerical noise, not
+    # drift (atol sized for CPU-backend reduction order, which differs
+    # from TPU's).
     jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-5),
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-3, atol=1e-4),
         p1,
         p2,
     )
@@ -60,6 +63,7 @@ def test_accum_must_divide_local_batch():
         )
 
 
+@pytest.mark.slow
 def test_lm_checkpoint_resume_exact(tmp_path):
     """Interrupt at step 3 of 6 (drop newer checkpoints), resume: the
     recovered run must land on the uninterrupted run's exact losses."""
@@ -83,6 +87,7 @@ def test_lm_checkpoint_resume_exact(tmp_path):
     np.testing.assert_allclose(losses_b, losses_full[3:], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_lm_resume_past_end_is_noop(tmp_path):
     tokens = synthetic_tokens(16, SMALL["seq_len"], SMALL["vocab_size"], seed=1)
     cfg = LMConfig(

@@ -29,6 +29,7 @@ def test_lm_cli_synthetic_train_and_generate(capsys):
     assert all(0 <= t < 32 for t in summary["sample"])
 
 
+@pytest.mark.slow
 def test_lm_cli_byte_corpus(tmp_path, capsys):
     corpus = tmp_path / "corpus.txt"
     corpus.write_bytes(b"the quick brown fox jumps over the lazy dog " * 40)
@@ -76,6 +77,7 @@ def test_byte_corpus_windows(tmp_path):
         byte_corpus(str(f), 200)
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_route(capsys):
     """--pipeline-parallel routes to PipelineLMTrainer (gpipe or 1f1b);
     incompatible flags are rejected, not silently dropped."""
@@ -129,6 +131,7 @@ def test_pipeline_parallel_route(capsys):
     ],
     ids=["weights", "kv-cache", "both", "all-scope"],
 )
+@pytest.mark.slow
 def test_lm_cli_int8_decode(capsys, flags):
     rc = main(TINY + [
         "--vocab-size", "32", "--generate", "4", "--prompt-len", "4",
@@ -149,6 +152,7 @@ def test_lm_cli_int8_head_scope_rejected_with_tied_embeddings(capsys):
         ])
 
 
+@pytest.mark.slow
 def test_lm_cli_llama_options_both_engines(capsys):
     # shard_map engine with rmsnorm + swiglu, incl. generation.
     rc = main(TINY + [
@@ -215,6 +219,7 @@ def test_lm_cli_pipeline_zero1_and_clip(capsys):
     assert summary["engine"] == "pipeline" and summary["finite"]
 
 
+@pytest.mark.slow
 def test_lm_cli_speculative_decode_with_fsdp(capsys):
     # --fsdp leaves both target and draft params in chunked [dp, chunk]
     # layout; the decode path must unshard BOTH (ADVICE r4: the draft's

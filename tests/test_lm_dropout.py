@@ -17,6 +17,9 @@ import jax
 import numpy as np
 import pytest
 
+# LM fit runs per case: heavy compile.
+pytestmark = pytest.mark.slow
+
 
 def _trainer(mesh, **kw):
     from cs744_pytorch_distributed_tutorial_tpu.train.lm import (

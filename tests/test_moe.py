@@ -50,6 +50,7 @@ def test_moe_capacity_overflow_drops_to_zero():
 
 
 @pytest.mark.parametrize("top_k", [1, 2])
+@pytest.mark.slow
 def test_moe_lm_trains(top_k):
     """A 2-device data-parallel MoE LM (experts local) learns the cyclic
     synthetic stream."""
@@ -64,6 +65,7 @@ def test_moe_lm_trains(top_k):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_expert_parallel_matches_local_experts():
     """EP over the data axis (all-to-all dispatch, sharded expert params)
     must match the identical model with every expert computed locally:
@@ -138,6 +140,7 @@ def test_scatter_dispatch_matches_einsum(top_k, groups):
     )
 
 
+@pytest.mark.slow
 def test_scatter_dispatch_trains_and_composes_with_ep():
     """Trajectory parity einsum vs scatter through the LM engine, and
     scatter under expert parallelism (the all-to-all sees identical
@@ -163,6 +166,7 @@ def test_scatter_dispatch_trains_and_composes_with_ep():
     np.testing.assert_allclose(base, run("scatter", ep=True), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_expert_parallel_with_grad_clip():
     """grad_clip_norm under EP (round 5): the spec-aware clip psums
     each expert-sharded leaf's squared-sum over the data axis, so the
@@ -193,6 +197,7 @@ def test_expert_parallel_with_grad_clip():
     )
 
 
+@pytest.mark.slow
 def test_expert_parallel_with_seq_parallel():
     """EP composes with sequence parallelism on a data x seq mesh: the
     2x2 EP run must match the same model with local experts."""
@@ -219,6 +224,7 @@ def test_expert_parallel_with_seq_parallel():
     )
 
 
+@pytest.mark.slow
 def test_expert_parallel_with_tensor_parallel():
     """EP composes with tensor parallelism on a data x tensor mesh:
     experts compute replicated over the tensor axis (Megatron shards the
@@ -286,23 +292,29 @@ def test_moe_metrics_surfaced_in_fit_history():
     params, opt = tr.init()
     x, y = tr.shard_batch(tokens[:4])
     params, opt, m = tr.train_step(params, opt, x, y)
-    assert set(m) == {"loss", "moe_aux", "moe_drop"}
+    # The obs/ telemetry PR widened the metrics dict: global grad/param
+    # norms always (non-ZeRO layouts) + the router's load entropy.
+    moe_keys = {"loss", "moe_aux", "moe_drop", "moe_load_entropy",
+                "grad_norm", "param_norm"}
+    assert set(m) == moe_keys
     aux, drop = float(m["moe_aux"]), float(m["moe_drop"])
     assert np.isfinite(aux) and aux > 0.0
     assert 0.0 < drop < 1.0, drop  # capacity 0.5 must drop something
+    assert 0.0 <= float(m["moe_load_entropy"]) <= 1.0
 
     tr.fit(tokens, steps=3)
-    assert set(tr.history) == {"loss", "moe_aux", "moe_drop"}
+    assert set(tr.history) == moe_keys
     assert len(tr.history["moe_drop"]) == 3
     assert all(0.0 <= d <= 1.0 for d in tr.history["moe_drop"])
+    assert all(0.0 <= e <= 1.0 for e in tr.history["moe_load_entropy"])
 
-    # Dense models keep the old metrics shape — no silent key creep.
+    # Dense models keep the non-MoE metrics shape — no silent key creep.
     dense = LMTrainer(cfg.replace(moe_experts=0),
                       mesh=make_mesh({"data": 1, "seq": 1},
                                      devices=jax.devices()[:1]))
     p2, o2 = dense.init()
     _, _, m2 = dense.train_step(p2, o2, x, y)
-    assert set(m2) == {"loss"}
+    assert set(m2) == {"loss", "grad_norm", "param_norm"}
 
 
 def test_moe_token_groups():

@@ -13,6 +13,9 @@ import sys
 
 import pytest
 
+# Spawns whole multi-process jax clusters; ~10s+ per case.
+pytestmark = pytest.mark.slow
+
 _WORKER = r"""
 import os, sys
 os.environ["PALLAS_AXON_POOL_IPS"] = ""

@@ -1,0 +1,364 @@
+"""The obs/ telemetry subsystem: sinks, manifest, helpers, and e2e
+runs of both engines writing real metric streams.
+
+CPU-only (conftest forces 8 virtual devices); the e2e tests exercise
+the same `--metrics-dir` path a TPU run uses.
+"""
+
+import json
+import logging
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
+    Telemetry,
+    expert_load_entropy,
+    speculative_accept_rate,
+    tree_l2_norm,
+)
+from cs744_pytorch_distributed_tutorial_tpu.obs.run_manifest import (
+    read_manifest,
+    write_manifest,
+)
+from cs744_pytorch_distributed_tutorial_tpu.obs.sinks import (
+    CsvSink,
+    JsonlSink,
+    RingSink,
+    sanitize,
+)
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_round_trip_sanitizes_nonfinite(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    sink = JsonlSink(path)
+    sink.emit({"kind": "step", "step": 0, "loss": 1.5})
+    sink.emit({"kind": "step", "step": 1, "loss": float("nan"),
+               "extra": float("inf")})
+    sink.emit({"kind": "step", "step": 2, "loss": jnp.float32(0.25)})
+    sink.close()
+    recs = [json.loads(line) for line in open(path)]
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert recs[0]["loss"] == 1.5
+    # NaN/inf must land as JSON null, not corrupt the stream.
+    assert recs[1]["loss"] is None and recs[1]["extra"] is None
+    # jax 0-d scalars coerce to plain floats.
+    assert recs[2]["loss"] == 0.25
+
+
+def test_csv_header_frozen_at_first_record(tmp_path):
+    path = str(tmp_path / "m.csv")
+    sink = CsvSink(path)
+    sink.emit({"step": 0, "loss": 1.0})
+    sink.emit({"step": 1, "loss": 2.0, "surprise": 9.9})  # extra key dropped
+    sink.emit({"step": 2})  # missing key -> empty cell
+    sink.close()
+    lines = open(path).read().splitlines()
+    assert lines[0] == "step,loss"
+    assert lines[1] == "0,1.0"
+    assert lines[2] == "1,2.0"  # 'surprise' did not widen the file
+    assert lines[3] == "2,"
+
+
+def test_ring_evicts_oldest():
+    ring = RingSink(capacity=3)
+    for i in range(5):
+        ring.emit({"step": i})
+    assert len(ring) == 3
+    assert [r["step"] for r in ring.records()] == [2, 3, 4]
+    assert [r["step"] for r in ring.tail(2)] == [3, 4]
+
+
+def test_sanitize_stringifies_unknown_objects():
+    out = sanitize({"a": object(), "b": None, "c": True})
+    assert isinstance(out["a"], str)
+    assert out["b"] is None and out["c"] is True
+
+
+# ---------------------------------------------------------------------------
+# Manifest
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_write_read(tmp_path):
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+
+    cfg = TrainConfig(num_devices=2, synthetic_data=True)
+    mesh = make_mesh({"data": 2})
+    path = write_manifest(str(tmp_path), config=cfg, mesh=mesh, extra_key=7)
+    man = read_manifest(str(tmp_path))  # dir or file path both accepted
+    assert man == read_manifest(path)
+    assert man["kind"] == "manifest"
+    assert man["mesh"] == {"data": 2}
+    assert man["config"]["num_devices"] == 2
+    assert man["device_count"] == jax.device_count()
+    assert man["jax_version"] == jax.__version__
+    assert man["extra_key"] == 7
+
+
+# ---------------------------------------------------------------------------
+# In-graph / host helpers
+# ---------------------------------------------------------------------------
+
+
+def test_tree_l2_norm_matches_numpy():
+    tree = {"a": jnp.arange(4, dtype=jnp.float32),
+            "b": {"c": jnp.full((2, 2), 2.0)}}
+    flat = np.concatenate([np.arange(4, dtype=np.float32), np.full(4, 2.0)])
+    assert float(tree_l2_norm(tree)) == pytest.approx(
+        float(np.linalg.norm(flat)), rel=1e-6
+    )
+
+
+def test_expert_load_entropy_bounds():
+    uniform = jnp.full((8,), 1.0 / 8)
+    collapsed = jnp.array([1.0] + [0.0] * 7)
+    assert float(expert_load_entropy(uniform)) == pytest.approx(1.0, abs=1e-5)
+    assert float(expert_load_entropy(collapsed)) == pytest.approx(0.0, abs=1e-4)
+    assert float(expert_load_entropy(jnp.ones((1,)))) == 1.0  # degenerate E=1
+
+
+def test_speculative_accept_rate():
+    # 64 tokens from 16 calls at k=4: (64/16 - 1)/4 = 0.75
+    assert speculative_accept_rate(64, 16, 4) == pytest.approx(0.75)
+    # every call accepted everything -> clamped to 1.0
+    assert speculative_accept_rate(100, 10, 4) == 1.0
+    assert speculative_accept_rate(10, 0, 4) is None
+    assert speculative_accept_rate(10, 10, 0) is None
+
+
+def test_telemetry_amortized_step_time_and_ring(tmp_path):
+    t = Telemetry(str(tmp_path), every=2, run="unit")
+    assert t.due(0) and not t.due(1) and t.due(2)
+    t.emit_step(0, loss=1.0)
+    time.sleep(0.02)
+    t.emit_step(2, loss=0.5)
+    t.close()
+    recs = [json.loads(line) for line in open(str(tmp_path / "metrics.jsonl"))]
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert steps[0]["step_time_s"] is None  # nothing to amortize over yet
+    # 2 steps elapsed between emissions -> per-step time is half the gap.
+    assert 0.005 < steps[1]["step_time_s"] < 10.0
+    assert len(t.ring) >= 2  # the ring mirrors every record
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-record [proc i/n] prefix
+# ---------------------------------------------------------------------------
+
+
+def test_logger_prefix_computed_per_record(monkeypatch):
+    import io
+
+    from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
+
+    logger = get_logger("cs744_tpu_obs_prefix_test")
+    stream = io.StringIO()
+    handler = logger.handlers[0]
+    old_stream = handler.stream
+    handler.stream = stream
+    try:
+        logger.info("single")
+        # "jax.distributed initializes" AFTER the logger exists — the
+        # prefix must pick up the new world size on the next record.
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setattr(jax, "process_index", lambda: 2)
+        logger.info("multi")
+    finally:
+        handler.stream = old_stream
+    lines = stream.getvalue().splitlines()
+    assert lines[0] == "single"
+    assert lines[1] == "[proc 2/4] multi"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: watchdog flushes the metric ring on firing
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_flushes_metric_ring():
+    from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+        StepWatchdog,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
+
+    ring = RingSink(capacity=8)
+    for i in range(3):
+        ring.emit({"kind": "step", "step": i, "loss": 1.0 / (i + 1)})
+
+    records: list[logging.LogRecord] = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    logger = get_logger()
+    cap = Capture()
+    logger.addHandler(cap)
+    try:
+        wd = StepWatchdog(timeout_s=0.1, dump_stacks=False, metric_ring=ring)
+        wd.arm()
+        time.sleep(0.4)
+        wd.disarm()
+        wd.close()
+    finally:
+        logger.removeHandler(cap)
+    assert wd.fired == 1
+    text = "\n".join(r.getMessage() for r in records)
+    assert "last 3 metric records" in text
+    # The actual records appear in the report, parseable.
+    assert '"step": 2' in text and '"loss"' in text
+
+
+# ---------------------------------------------------------------------------
+# E2E: CIFAR engine via the CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_cifar_cli(metrics_dir, extra=()):
+    from cs744_pytorch_distributed_tutorial_tpu.cli import main
+
+    rc = main([
+        "--sync", "allreduce", "--model", "tiny_cnn", "--num-devices", "2",
+        "--global-batch-size", "16", "--epochs", "1", "--synthetic-data",
+        "--synthetic-train-size", "80", "--synthetic-test-size", "16",
+        "--log-every", "1", "--metrics-dir", str(metrics_dir), *extra,
+    ])
+    assert rc == 0
+    path = metrics_dir / "metrics.jsonl"
+    return [json.loads(line) for line in open(path)]
+
+
+def test_cifar_cli_writes_manifest_and_step_stream(tmp_path):
+    recs = _run_cifar_cli(tmp_path / "run")
+
+    man = read_manifest(str(tmp_path / "run"))
+    assert man["run"] == "cifar"
+    assert man["config"]["model"] == "tiny_cnn"
+    assert man["mesh"] == {"data": 2}
+    assert man["grad_sync_bytes_per_step"] > 0
+
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert len(steps) == 5  # 80 samples / batch 16, 1 epoch
+    indices = [r["step"] for r in steps]
+    assert indices == sorted(indices) and len(set(indices)) == len(indices)
+    for r in steps:
+        assert math.isfinite(r["loss"])
+        assert math.isfinite(r["grad_norm"]) and r["grad_norm"] > 0
+        assert math.isfinite(r["param_norm"]) and r["param_norm"] > 0
+        assert r["grad_sync_bytes"] > 0
+        assert r["lr"] > 0
+    # step_time_s is amortized: null first, positive after.
+    assert steps[0]["step_time_s"] is None
+    assert all(s["step_time_s"] > 0 for s in steps[1:])
+    # the epoch boundary feeds the DivergenceMonitor verdict + eval in.
+    events = {r["event"] for r in recs if r["kind"] == "event"}
+    assert "eval" in events
+
+
+def test_int8_compression_shrinks_recorded_wire_bytes(tmp_path):
+    f32 = _run_cifar_cli(tmp_path / "f32")
+    int8 = _run_cifar_cli(tmp_path / "int8", extra=["--grad-compress", "int8"])
+    f32_bytes = next(r["grad_sync_bytes"] for r in f32 if r["kind"] == "step")
+    int8_bytes = next(
+        r["grad_sync_bytes"] for r in int8 if r["kind"] == "step"
+    )
+    assert 0 < int8_bytes < f32_bytes
+    # int8 payload + per-chunk f32 scales ≈ 3.9x smaller than f32.
+    assert f32_bytes / int8_bytes > 3.0
+
+
+# ---------------------------------------------------------------------------
+# E2E: LM engine
+# ---------------------------------------------------------------------------
+
+
+def test_lm_fit_emits_metrics(tmp_path):
+    from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
+        LMConfig,
+        LMTrainer,
+    )
+
+    cfg = LMConfig(
+        vocab_size=64, num_layers=1, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=32, attention_impl="dense", data_parallel=2,
+        global_batch_size=4, seq_len=16,
+        metrics_dir=str(tmp_path), metrics_every=1,
+    )
+    tokens = np.random.default_rng(0).integers(
+        0, 64, size=(16, 17), dtype=np.int32
+    )
+    LMTrainer(cfg).fit(tokens, steps=3)
+
+    man = read_manifest(str(tmp_path))
+    assert man["run"] == "lm" and man["n_params"] > 0
+    steps = [
+        json.loads(line)
+        for line in open(str(tmp_path / "metrics.jsonl"))
+    ]
+    steps = [r for r in steps if r["kind"] == "step"]
+    assert [r["step"] for r in steps] == [0, 1, 2]
+    for r in steps:
+        assert math.isfinite(r["loss"])
+        assert math.isfinite(r["grad_norm"]) and r["grad_norm"] > 0
+        assert r["grad_sync_bytes"] == man["grad_sync_bytes_per_step"] > 0
+
+
+def test_lm_cli_rejects_metrics_dir_on_pipeline(tmp_path):
+    from cs744_pytorch_distributed_tutorial_tpu.lm_cli import main
+
+    with pytest.raises(SystemExit, match="metrics-dir"):
+        main([
+            "--pipeline-parallel", "2", "--steps", "1",
+            "--metrics-dir", str(tmp_path),
+        ])
+
+
+# ---------------------------------------------------------------------------
+# benchmarks/metrics_summary.py
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_summary_tabulates(tmp_path):
+    import pathlib
+    import sys
+
+    sys.path.insert(
+        0, str(pathlib.Path(__file__).resolve().parent.parent / "benchmarks")
+    )
+    try:
+        from metrics_summary import load_records, summarize
+    finally:
+        sys.path.pop(0)
+
+    path = tmp_path / "m.jsonl"
+    recs = [
+        {"kind": "manifest"},
+        {"kind": "step", "step": 0, "loss": 2.0, "step_time_s": None,
+         "grad_sync_bytes": 100},
+        {"kind": "step", "step": 1, "loss": 1.0, "step_time_s": 9.0,
+         "grad_sync_bytes": 100, "mfu": 0.4},
+        {"kind": "step", "step": 2, "loss": 1.5, "step_time_s": 0.5,
+         "grad_sync_bytes": 100, "mfu": 0.6},
+        {"kind": "event", "event": "eval"},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    s = summarize(load_records(str(path)))
+    assert s["step_records"] == 3
+    assert s["step_range"] == (0, 2)
+    # first recorded step time (9.0, the compile step) is excluded.
+    assert s["mean_step_time_s"] == pytest.approx(0.5)
+    assert s["final_loss"] == 1.5 and s["best_loss"] == 1.0
+    assert s["mean_mfu"] == pytest.approx(0.5)
+    assert s["total_grad_sync_bytes"] == 300
+    assert s["events"] == ["eval"]

@@ -10,6 +10,9 @@ from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
 from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
 from cs744_pytorch_distributed_tutorial_tpu.train.state import make_optimizer
 
+# CPU-interpret Pallas fused-SGD kernels: heavy compile.
+pytestmark = pytest.mark.slow
+
 LR, MU, WD = 0.1, 0.9, 1e-4
 
 

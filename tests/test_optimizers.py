@@ -64,6 +64,7 @@ def test_unknown_optimizer_and_schedule_rejected():
         make_schedule(TrainConfig(lr_schedule="step"))
 
 
+@pytest.mark.slow
 def test_adamw_trains(mesh4):
     """AdamW + warmup-cosine runs the full distributed step: finite losses,
     params move, trajectory differs from SGD's."""

@@ -76,6 +76,7 @@ def test_spmd_pipeline_identity_stage():
     np.testing.assert_allclose(np.asarray(out), np.asarray(x) + 4.0)
 
 
+@pytest.mark.slow
 def test_forward_parity_vs_sequential():
     """Pipelined forward over 4 stages == unpipelined forward, same params."""
     tr = make_trainer(data=1, pipe=4, layers=4, microbatches=4)
@@ -100,6 +101,7 @@ def test_forward_invariant_to_microbatch_count():
     np.testing.assert_allclose(outs[0], outs[2], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_grad_parity_vs_sequential():
     """One pipelined train-step gradient == the sequential model's gradient
     (the AD-derived reverse pipeline is exact, not approximate)."""
@@ -198,6 +200,7 @@ def test_config_validation():
         make_trainer(attention_impl="ring")
 
 
+@pytest.mark.slow
 def test_pipeline_flash_attention_matches_dense():
     """attention_impl='flash' routes pipeline blocks through the Pallas
     kernel (interpret on CPU): same first-step loss as dense."""
@@ -223,6 +226,7 @@ def test_block_param_names_in_sync():
 # First-class promotion (round 3): real Block, cross-engine parity,
 # tensor axis, checkpoint/resume, eval
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_cross_engine_parity_with_lm_trainer():
     """The pipeline runs the SAME flax Block as LMTrainer: converting a
     TransformerLM init through from_transformer_lm_params and running it
@@ -272,6 +276,7 @@ def test_cross_engine_parity_with_lm_trainer():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_dp_pp_tp_training(mesh8):
     """data x pipe x tensor on one mesh: the tensor axis shards each
     stage's q/k/v/mlp kernels (Megatron boundaries inside Block) and the
@@ -343,6 +348,7 @@ def test_vocab_sharded_head_logits_and_ce(mesh8):
     np.testing.assert_allclose(ev, ref, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_rope_gqa_flash_remat_1f1b():
     """The promoted feature set composes: RoPE + GQA + flash + remat on
     the 1F1B schedule trains and matches its own gpipe twin."""
@@ -361,6 +367,7 @@ def test_pipeline_rope_gqa_flash_remat_1f1b():
     assert losses["1f1b"] == pytest.approx(losses["gpipe"], rel=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_moe_expert_parallel():
     """ep x pp: MoE blocks with experts sharded over the data axis
     (all-to-all dispatch inside the stage function) train through BOTH
@@ -394,6 +401,7 @@ def test_pipeline_moe_expert_parallel():
     )
 
 
+@pytest.mark.slow
 def test_pipeline_optimizer_registry():
     """The shared train/state.py registry drives the pipeline engine:
     sgd/lion and a warmup-cosine schedule all step."""
@@ -408,6 +416,7 @@ def test_pipeline_optimizer_registry():
         assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow
 def test_pipeline_checkpoint_resume_bit_identical(tmp_path):
     """fit(6) in one run == fit(3) + crash + fit(6) resumed from the
     step-3 checkpoint: identical loss tail and identical final params —
@@ -445,6 +454,7 @@ def test_pipeline_checkpoint_resume_bit_identical(tmp_path):
 # ---------------------------------------------------------------------------
 # Interleaved (virtual-stage) schedule
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_interleaved_forward_parity_and_grads():
     """V=2 virtual stages over S=2 devices: pipelined forward matches the
     unpipelined reference on the same logical params; one train step
@@ -557,6 +567,7 @@ def test_interleaved_validation():
 # ---------------------------------------------------------------------------
 # Dropout through the pipeline schedules (round 3)
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_pipeline_dropout_gpipe_1f1b_parity():
     """Dropout masks are keyed by (step, data shard, storage layer id,
     microbatch) — derivable identically under both schedules — so gpipe
@@ -583,6 +594,7 @@ def test_pipeline_dropout_gpipe_1f1b_parity():
     )
 
 
+@pytest.mark.slow
 def test_pipeline_dropout_stream_properties():
     """Same (state, step) -> identical loss; different step -> different
     masks -> different loss; rate 0 reproduces the dropout-free path."""
@@ -611,6 +623,7 @@ def test_pipeline_dropout_stream_properties():
     assert float(m0["loss"]) != float(m_a["loss"])  # dropout changes it
 
 
+@pytest.mark.slow
 def test_pipeline_dropout_interleaved():
     """Dropout composes with the interleaved schedule: the chunk index
     rides through chunk_fn so each (chunk, layer) keeps a distinct mask
@@ -685,6 +698,7 @@ def test_pipeline_dropout_chunk_identity_folded():
     np.testing.assert_array_equal(out_v0, run(0, 0))  # deterministic
 
 
+@pytest.mark.slow
 def test_pipeline_halt_on_nonfinite():
     """The failure-detection contract shared with the other engines: a
     diverged run (lr 1e30 blows params up within a few steps) raises
@@ -709,6 +723,7 @@ def test_pipeline_halt_on_nonfinite():
     assert len(losses) == 3  # ran through, divergence recorded not raised
 
 
+@pytest.mark.slow
 def test_pipeline_divergence_safe_checkpointing(tmp_path):
     """A checkpoint due at step k is persisted only after a LATER
     forward over its params comes back finite: when the run diverges,
@@ -781,6 +796,7 @@ def _run_one_step(schedule, mesh, m=4):
     return float(metrics["loss"]), params
 
 
+@pytest.mark.slow
 def test_1f1b_matches_gpipe(mesh4):
     """The hand-scheduled 1F1B backward must produce the SAME loss and
     parameter update as AD of the GPipe forward — the grad-parity gate
@@ -807,6 +823,7 @@ def test_1f1b_matches_gpipe(mesh4):
     )
 
 
+@pytest.mark.slow
 def test_1f1b_single_stage_degenerates(mesh4):
     """S=1: no hops, every wave is fwd+bwd of the same microbatch; the
     schedule must still match gpipe exactly."""
@@ -896,6 +913,7 @@ def _sp_pp_trainer(sp, pipe=2, data=1, impl="ring", schedule="gpipe", **kw):
     ("ring", "1f1b"),
     ("ulysses", "gpipe"),
 ])
+@pytest.mark.slow
 def test_sp_pp_loss_parity(impl, schedule):
     """sp=2 inside pp=2 reproduces the sp=1 pipeline's loss trajectory
     from the same init — the seq sharding (ring/Ulysses attention, seq-
@@ -938,6 +956,7 @@ def test_sp_pp_abs_positions():
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_sp_pp_tp_composes(mesh8):
     """dp x sp x tp inside pp on one 4-D mesh: one finite training step
     (the full composition — ring attention over seq, Megatron sharding
@@ -1021,6 +1040,7 @@ def test_1f1b_distributed_tail_head_width():
     assert sliced, "no V/S-width head dot found — tail not sharded?"
 
 
+@pytest.mark.slow
 def test_1f1b_distributed_tail_composes_with_tensor_axis():
     """Round 5 (VERDICT r4 #5): with a tensor axis the per-stage tail
     width is V/(S*T), not V/T — the jaxpr must contain head matmuls at
@@ -1092,6 +1112,7 @@ def test_1f1b_distributed_tail_composes_with_tensor_axis():
     )
 
 
+@pytest.mark.slow
 def test_1f1b_distributed_tail_fallback_when_indivisible():
     """vocab % pipe != 0 falls back to the replicated tail (correct,
     just unsharded) rather than refusing the config."""

@@ -9,6 +9,7 @@ from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
 from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
 
+@pytest.mark.slow
 def test_fit_captures_profile_trace(mesh4, tmp_path):
     """profile_dir + a window inside the run: fit records an XLA trace
     (TensorBoard profile-plugin layout) and training completes normally."""

@@ -257,6 +257,7 @@ def test_quant_kv_cache_beam_runs():
     assert out.shape == (1, 4) and np.isfinite(np.asarray(scores)).all()
 
 
+@pytest.mark.slow
 def test_quantized_eval_loss_close_after_training():
     """Quality evidence on a TRAINED model (random-init logit noise says
     little about deployment): int8-all quantization moves held-out

@@ -7,6 +7,7 @@ LM engines (seq-parallel LMTrainer and the pipelined trainer).
 """
 
 import numpy as np
+import pytest
 
 from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
 from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
@@ -15,6 +16,9 @@ from cs744_pytorch_distributed_tutorial_tpu.parallel.pipeline import (
     PipelineLMTrainer,
 )
 from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+# LM remat-vs-unremat fit pairs: heavy compile.
+pytestmark = pytest.mark.slow
 
 SMALL = dict(
     vocab_size=64, num_layers=2, num_heads=4, d_model=64, d_ff=128,

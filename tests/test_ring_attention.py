@@ -75,6 +75,7 @@ def test_ulysses_flash_inner_matches_dense(mesh8, qkv, causal):
     np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_flash_lm_trains():
     """attention_impl='ulysses_flash' end to end on a data x seq mesh."""
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
@@ -117,6 +118,7 @@ def test_ring_flash_matches_dense(mesh8, qkv, causal):
     np.testing.assert_allclose(got, expected, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_flash_gradients_match_dense(mesh4, qkv):
     """The ring FA-2 backward (per-hop flash_dq/flash_dkv against the
     merged lse, dk/dv accumulators riding the ring home) must agree with
@@ -146,6 +148,7 @@ def test_ring_flash_gradients_match_dense(mesh4, qkv):
         )
 
 
+@pytest.mark.slow
 def test_ring_flash_lm_trains():
     """attention_impl='ring_flash' end to end on a data x seq mesh."""
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens

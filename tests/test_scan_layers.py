@@ -203,6 +203,7 @@ def test_param_specs_scanned_layout(unrolled_params):
     assert specs["tok_embed"]["embedding"] == P()
 
 
+@pytest.mark.slow
 def test_trainer_scan_layers_loss_parity(mesh8):
     """LMTrainer(scan_layers=True) takes the stacked version of the
     unrolled trainer's params to the SAME loss — the full shard_map

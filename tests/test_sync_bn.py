@@ -36,6 +36,7 @@ def test_sync_bn_makes_replica_stats_identical(mesh4):
         np.testing.assert_allclose(row, sync[0], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_sync_bn_single_device_matches_local(mesh4):
     """On a 1-sized axis the psum is the identity: sync_bn == local BN
     bit-for-bit (the reference semantics are untouched)."""

@@ -37,6 +37,7 @@ def _global(tree):
 
 
 @pytest.mark.parametrize("tp", [2, 4])
+@pytest.mark.slow
 def test_tp_loss_matches_single_device(tp):
     toks = _tokens()
     cfg1 = LMConfig(**SMALL, attention_impl="dense")
@@ -65,6 +66,7 @@ def test_tp_loss_matches_single_device(tp):
     assert np.isclose(l1, ltp, rtol=1e-5), (l1, ltp)
 
 
+@pytest.mark.slow
 def test_tp_train_step_matches_single_device():
     toks = _tokens(seed=1)
     cfg1 = LMConfig(**SMALL, attention_impl="dense")
@@ -120,6 +122,7 @@ def test_tp_params_are_actually_sharded():
     assert params["ln_f"]["scale"].sharding.spec == P()
 
 
+@pytest.mark.slow
 def test_tp_composes_with_ring_and_data_and_seq_axes():
     cfg = LMConfig(
         **SMALL,
@@ -135,6 +138,7 @@ def test_tp_composes_with_ring_and_data_and_seq_axes():
     assert losses[-1] != losses[0]
 
 
+@pytest.mark.slow
 def test_tp_composes_with_ulysses():
     cfg = LMConfig(
         **SMALL,

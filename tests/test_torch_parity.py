@@ -336,6 +336,7 @@ def _copy_flax_vgg_params_to_torch(params, tmodel):
         linear.bias.copy_(torch.from_numpy(np.asarray(d["bias"])))
 
 
+@pytest.mark.slow
 def test_vgg11_loss_curve_matches_torch_trajectory(mesh4):
     """SURVEY §4's north star: loss-curve parity against the reference's
     ACTUAL torch trajectory, not just a self-recorded golden trace.

@@ -15,6 +15,9 @@ import jax
 import numpy as np
 import pytest
 
+# Tensor-parallel decode engines: heavy compile per case.
+pytestmark = pytest.mark.slow
+
 
 def _make_trainer(mesh, tensor):
     from cs744_pytorch_distributed_tutorial_tpu.train.lm import (

@@ -41,6 +41,7 @@ def test_dp_training_learns(dataset):
     assert hist["eval"][-1]["count"] == 128  # all test shards counted
 
 
+@pytest.mark.slow
 def test_single_device_part1(dataset):
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     cfg = config_for_part("1", model="tiny_cnn", global_batch_size=64,

@@ -15,10 +15,12 @@ from __future__ import annotations
 import json
 import os
 
+import pytest
 import jax
 import numpy as np
 
 
+@pytest.mark.slow
 def test_vgg11_through_trainer_fit(mesh4):
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10

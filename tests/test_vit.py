@@ -61,6 +61,7 @@ def test_vit_flash_matches_dense():
     )
 
 
+@pytest.mark.slow
 def test_vit_dropout_trains_and_eval_is_deterministic(mesh4):
     """dropout_rate > 0: training runs (engine supplies the rng), the
     trajectory differs from rate 0, and eval stays deterministic."""
@@ -103,6 +104,7 @@ def test_vit_dropout_trains_and_eval_is_deterministic(mesh4):
                                 synthetic_data=True), mesh=mesh4)
 
 
+@pytest.mark.slow
 def test_vit_trains_distributed(mesh4):
     """ViT under the same DP engine as VGG/ResNet: finite losses, empty
     per-replica batch_stats, eval runs."""

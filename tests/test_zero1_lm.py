@@ -57,6 +57,7 @@ def _run(cfg, mesh, steps=6):
     return tr, params, opt, losses
 
 
+@pytest.mark.slow
 def test_zero1_trajectory_matches_replicated_adamw():
     """dp=4: the sharded-moment trajectory IS the replicated adamw
     trajectory (same schedule, bias correction, decoupled decay)."""
@@ -66,6 +67,7 @@ def test_zero1_trajectory_matches_replicated_adamw():
     np.testing.assert_allclose(base, z1, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_zero1_composes_with_seq_and_scan_and_accum():
     """dp2 x sp2 with scan_layers and accumulation: the seq pmean runs
     on the chunk, scan-stacked leaves chunk like any other, and the
@@ -112,6 +114,7 @@ def test_zero1_rejections():
 
 
 @pytest.mark.parametrize("opt", ["lion", "sgd"])
+@pytest.mark.slow
 def test_zero1_lion_sgd_trajectory_matches_replicated(opt):
     """Round 5: zero1 carries all three registry rules chunk-wise —
     lion (ONE sharded moment: Lion's halved state stacks with the
@@ -131,6 +134,7 @@ def test_zero1_lion_sgd_trajectory_matches_replicated(opt):
 
 
 @pytest.mark.parametrize("opt", ["lion", "sgd"])
+@pytest.mark.slow
 def test_fsdp_lion_sgd_trajectory_matches_replicated(opt):
     """FSDP runs the same rule family (MRO composition FsdpLion /
     FsdpSgdLM): chunked params + single-moment state still match the
@@ -152,6 +156,7 @@ def test_fsdp_lion_sgd_trajectory_matches_replicated(opt):
 # --------------------------------------------------------------------------
 # ZeRO x tensor parallelism + global-norm clipping (round 5)
 # --------------------------------------------------------------------------
+@pytest.mark.slow
 def test_zero1_tp_trajectory_matches_replicated():
     """dp2 x tp2: tensor-sharded leaves chunk their LOCAL shard per
     (data, tensor) coordinate — the trajectory still IS the replicated
@@ -182,6 +187,7 @@ def test_zero1_tp_moment_layout():
     assert tuple(ln.sharding.spec)[:1] == ("data",)
 
 
+@pytest.mark.slow
 def test_zero_clip_matches_replicated_clip():
     """zero1 + grad_clip_norm: the chunked path computes the EXACT
     global norm (one psum of per-chunk squared sums) — trajectory
@@ -198,6 +204,7 @@ def test_zero_clip_matches_replicated_clip():
     )
 
 
+@pytest.mark.slow
 def test_fsdp_tp_trajectory_and_decode():
     """dp2 x tp2 FSDP: chunked-per-(data,tensor) params gather to the
     LOCAL tensor shard inside the step; trajectory matches the
@@ -229,6 +236,7 @@ def test_fsdp_tp_trajectory_and_decode():
     )
 
 
+@pytest.mark.slow
 def test_zero_full_matrix_dp_sp_tp():
     """The whole composition at once — dp2 x sp2 x tp2 with ring
     attention, scan_layers, accumulation AND clipping, zero1 vs the
@@ -250,6 +258,7 @@ def test_zero_full_matrix_dp_sp_tp():
 
 
 @pytest.mark.parametrize("dp_save,dp_resume", [(4, 2), (2, 4)])
+@pytest.mark.slow
 def test_zero1_elastic_resume(tmp_path, dp_save, dp_resume):
     """Mesh-elastic ZeRO resume (VERDICT r4 #4): save at dp_save,
     resume at dp_resume — the restore re-chunks [dp_old, c_old] flat
@@ -280,6 +289,7 @@ def test_zero1_elastic_resume(tmp_path, dp_save, dp_resume):
     np.testing.assert_allclose(head + tail, full, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_elastic_resume_rejects_model_shape_change(tmp_path):
     """The elastic re-chunk only bends over data_parallel: resuming a
     zero1 checkpoint with a CHANGED model shape (stale flat chunks)
@@ -302,6 +312,7 @@ def test_elastic_resume_rejects_model_shape_change(tmp_path):
         bigger.fit(tokens, steps=4)
 
 
+@pytest.mark.slow
 def test_fsdp_elastic_resume_with_tp(tmp_path):
     """FSDP chunked PARAMS re-chunk too, and the tensor coordinate
     (middle axis) rides along untouched: save on dp2 x tp2, resume on
@@ -326,6 +337,7 @@ def test_fsdp_elastic_resume_with_tp(tmp_path):
     np.testing.assert_allclose(head + tail, full, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_sharded_clip_matches_single_device_optax_clip():
     """The replicated-optimizer path under TP now clips via the
     spec-aware transform (train/state.py::clip_by_global_norm_sharded):
@@ -341,6 +353,7 @@ def test_sharded_clip_matches_single_device_optax_clip():
     np.testing.assert_allclose(base, tp, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_zero1_checkpoint_resume(tmp_path):
     """Orbax save/restore round-trips the chunked state: an interrupted
     zero1 run resumes to the identical trajectory."""
@@ -366,6 +379,7 @@ def test_zero1_checkpoint_resume(tmp_path):
 # --------------------------------------------------------------------------
 # ZeRO-3 / FSDP (FsdpAdam, LMConfig.fsdp)
 # --------------------------------------------------------------------------
+@pytest.mark.slow
 def test_fsdp_trajectory_matches_replicated_adamw():
     """dp=4: gather-just-in-time + chunk AdamW IS the replicated
     trajectory (the unshard/scatter pair is numerically transparent)."""
@@ -375,6 +389,7 @@ def test_fsdp_trajectory_matches_replicated_adamw():
     np.testing.assert_allclose(base, f, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_fsdp_params_are_sharded_and_decode_roundtrips():
     """Params persist as [dp, chunk] data-sharded arrays; the decode
     path unshards them to logits that match the replicated run's."""
@@ -404,6 +419,7 @@ def test_fsdp_params_are_sharded_and_decode_roundtrips():
     )
 
 
+@pytest.mark.slow
 def test_fsdp_composes_with_seq_scan_accum_and_resumes(tmp_path):
     """dp2 x sp2 + scan_layers + accumulation, with an interrupted run
     resuming mid-trajectory — all on chunked params."""
@@ -447,6 +463,7 @@ def test_fsdp_zero1_mutually_exclusive():
 
 
 @pytest.mark.parametrize("mode", ["zero1", "fsdp"])
+@pytest.mark.slow
 def test_zero_expert_parallel_trajectory_matches_replicated(mode):
     """dp4 + EP(moe) + clip: the mixed layout (chunked replicated
     leaves, natural-local expert leaves) IS the replicated optimizer —
@@ -477,6 +494,7 @@ def test_zero_expert_parallel_trajectory_matches_replicated(mode):
         assert host["block_0"]["moe"]["w_in"].shape == (4, 32, 64)
 
 
+@pytest.mark.slow
 def test_zero1_expert_parallel_resume(tmp_path):
     """Mixed-layout checkpoint resume under zero1+EP. Same-dp resume is
     EXACT (chunked leaves plus natural expert moments restore placed on

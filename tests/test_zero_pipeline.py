@@ -75,6 +75,7 @@ def _run(cfg, mesh, steps=6):
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.slow
 def test_pipeline_zero1_trajectory_matches_replicated(schedule):
     """dp2 x pp2: the data-sharded-moment trajectory IS the replicated
     adamw trajectory, on both the AD-derived and hand-scheduled
@@ -87,6 +88,7 @@ def test_pipeline_zero1_trajectory_matches_replicated(schedule):
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.slow
 def test_pipeline_zero1_with_tensor_and_clip(schedule):
     """dp2 x pp2 x tp2 with grad clipping: block kernels chunk per
     (pipe, tensor) coordinate, the clip's psum spans (data, pipe,
@@ -111,6 +113,7 @@ def test_pipeline_zero1_with_tensor_and_clip(schedule):
     assert not np.allclose(z1[1:], unclipped[1:], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_clip_is_pipe_count_invariant():
     """The sharded clip's norm is exact for any pipe size: pp2 and pp4
     trajectories with clipping match on the same global batch (block
@@ -146,6 +149,7 @@ def test_pipeline_zero1_moment_layout():
     assert int(opt["count"]) == 1
 
 
+@pytest.mark.slow
 def test_pipeline_zero1_resume_and_elastic(tmp_path):
     """Orbax resume oracle (VERDICT r4 #3's done-criterion) plus the
     mesh-elastic re-chunk: save at dp2 x pp2, resume at dp1 x pp2 —
@@ -195,6 +199,7 @@ def test_pipeline_zero1_rejections():
     # test_pipeline_zero_expert_parallel below.
 
 
+@pytest.mark.slow
 def test_pipeline_zero1_lion_matches_replicated():
     """The round-5 rule family runs on the pipeline engine too: lion
     (one sharded moment) under dp2 x pp2 matches the replicated
@@ -216,6 +221,7 @@ def test_pipeline_zero1_lion_matches_replicated():
 
 
 @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.slow
 def test_pipeline_fsdp_trajectory_matches_replicated(schedule):
     """dp2 x pp2: chunk-sharded params + just-in-time gather IS the
     replicated trainer — same losses, and the unsharded final params
@@ -234,6 +240,7 @@ def test_pipeline_fsdp_trajectory_matches_replicated(schedule):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_fsdp_with_tensor_and_clip():
     """dp2 x pp2 x tp2 (1f1b — the composed distributed tail) with
     grad clipping: block kernels chunk per (pipe, tensor) coordinate
@@ -264,6 +271,7 @@ def test_pipeline_fsdp_with_tensor_and_clip():
     assert not np.allclose(fs[1:], unclipped[1:], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_fsdp_resume_and_elastic(tmp_path):
     """Orbax resume oracle for chunked params: save at dp2 x pp2,
     resume at dp2 (exact layout) AND at dp1 (params + moments re-chunk
@@ -295,6 +303,7 @@ def test_pipeline_fsdp_resume_and_elastic(tmp_path):
     np.testing.assert_allclose(head_e + tail_e, full, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_fsdp_lion_matches_replicated():
     """FsdpLion on the pipeline engine (params + ONE moment chunked):
     dp2 x pp2 matches the replicated optax.lion trajectory."""
@@ -317,6 +326,7 @@ def test_pipeline_fsdp_rejections():
         )
 
 
+@pytest.mark.slow
 def test_pipeline_zero_expert_parallel():
     """ZeRO x EP on the pipeline engine (late round 5 — the rejection
     removed): dp2 x pp2 with experts sharded over data; expert moments
@@ -342,6 +352,7 @@ def test_pipeline_zero_expert_parallel():
     assert emb_mu.ndim == 2 and emb_mu.shape[0] == 2  # [dp, chunk]
 
 
+@pytest.mark.slow
 def test_pipeline_zero_interleaved_schedule():
     """The ZeRO machinery is schedule-agnostic — it chunks the STORAGE
     layout, which the interleaved schedule permutes but does not
@@ -359,6 +370,7 @@ def test_pipeline_zero_interleaved_schedule():
     np.testing.assert_allclose(base, fs, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_dropless_moe_in_stages():
     """Dropless MoE inside pipeline stages (the ragged grouped matmuls
     trace under the scanned stage body): matches the uncapped scatter
