@@ -27,8 +27,6 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-
 from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
 from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
 from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer

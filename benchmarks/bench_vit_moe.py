@@ -71,7 +71,6 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 
 V5E_PEAK_FLOPS = 197e12
 STEPS, WARMUP = 12, 8

@@ -178,6 +178,8 @@ def main() -> None:
     for blk in (16, 32, 64, 128):
         try:
             fn = (
+                # graftlint: disable=GL002 -- one compile per block_batch
+                # IS the probe; nothing to hoist.
                 jax.jit(
                     functools.partial(conv3x3_fwd_hpair, block_batch=blk)
                 )

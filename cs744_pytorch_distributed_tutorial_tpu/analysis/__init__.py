@@ -1,0 +1,59 @@
+"""graftlint — JAX/TPU-aware static analysis for this repository.
+
+An AST-based linter (stdlib ``ast`` only, no third-party deps) for the
+bug classes that silently destroy TPU throughput and that no generic
+Python linter sees:
+
+- **GL001 host-sync-in-jit-scope** — ``.item()``/``float()``/
+  ``np.asarray``/``jax.device_get``/bool-coercion of traced values
+  inside jit/pjit/shard_map/scan-traced code, and unconditional
+  device fetches inside the host-side step loop.
+- **GL002 retrace-hazard** — jit wrappers constructed inside loops;
+  unhashable or per-call-fresh values (dict/list/f-string) passed in
+  ``static_argnums``/``static_argnames`` positions.
+- **GL003 donation-after-use** — arguments listed in ``donate_argnums``
+  read after the jitted call that donated their buffers.
+- **GL004 prng-key-reuse** — the same PRNG key consumed by two
+  ``jax.random.*`` draws without an intervening split/fold_in/rebind.
+- **GL005 collective-axis-drift** — hardcoded axis-name literals in
+  ``psum``/``all_gather``/... that don't appear in any mesh/spec the
+  module declares.
+- **GL006 mutable-default-arg** — the classic Python footgun.
+- **GL007 unguarded-time-in-trace** — ``time.time()``-style host clock
+  reads baked into traced code (they freeze at trace time).
+- **GL008 dead-import** — module-level imports never used.
+
+Usage::
+
+    python -m cs744_pytorch_distributed_tutorial_tpu.analysis [paths...] \
+        [--format=text|json] [--baseline FILE] [--write-baseline]
+
+Per-line suppressions: ``# graftlint: disable=GL001 -- reason`` on the
+finding's first line (or on a comment line directly above it).
+Repo-wide residual findings live in the checked-in baseline file
+(``graftlint_baseline.json``); CI fails on any non-baselined finding.
+"""
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.core import (
+    Baseline,
+    Config,
+    Finding,
+    Suppressions,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.engine import (
+    Report,
+    lint_paths,
+    lint_source,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES",
+    "Baseline",
+    "Config",
+    "Finding",
+    "Report",
+    "Suppressions",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,7 @@
+"""``python -m cs744_pytorch_distributed_tutorial_tpu.analysis`` entry."""
+
+import sys
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.cli import main
+
+sys.exit(main())

@@ -1,0 +1,142 @@
+"""graftlint CLI.
+
+``python -m cs744_pytorch_distributed_tutorial_tpu.analysis [paths...]``
+
+Exit codes: 0 clean, 1 findings (or unreadable/syntax-error files),
+2 usage error. ``--write-baseline`` records the current findings as the
+accepted residual and exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.core import Baseline, Config
+from cs744_pytorch_distributed_tutorial_tpu.analysis.engine import lint_paths
+from cs744_pytorch_distributed_tutorial_tpu.analysis.rules import ALL_RULES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX/TPU-aware static analysis (GL001-GL008).",
+    )
+    p.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: [tool.graftlint] "
+        "paths from pyproject.toml)",
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file of accepted findings (default: [tool.graftlint] "
+        "baseline, falling back to graftlint_baseline.json)",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline file",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="record current findings as the accepted baseline and exit 0",
+    )
+    p.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--disable",
+        default=None,
+        help="comma-separated rule ids to skip",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rid, fn in sorted(ALL_RULES.items()):
+            doc = (fn.__doc__ or "").strip().splitlines()[0]
+            print(f"{rid}  {doc}")
+        return 0
+
+    config = Config.load()
+    paths = args.paths or config.paths
+    if not paths:
+        print(
+            "graftlint: no paths given and no [tool.graftlint] paths "
+            "configured",
+            file=sys.stderr,
+        )
+        return 2
+
+    rules = dict(ALL_RULES)
+    if args.select:
+        wanted = {r.strip().upper() for r in args.select.split(",") if r.strip()}
+        unknown = wanted - rules.keys()
+        if unknown:
+            print(f"graftlint: unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = {rid: fn for rid, fn in rules.items() if rid in wanted}
+    for rid in list(args.disable.split(",") if args.disable else []) + list(
+        config.disable
+    ):
+        rules.pop(rid.strip().upper(), None)
+
+    baseline_path = Path(args.baseline or config.baseline)
+    baseline = None
+    if not args.no_baseline and not args.write_baseline and baseline_path.is_file():
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graftlint: bad baseline {baseline_path}: {e}", file=sys.stderr)
+            return 2
+
+    report = lint_paths(paths, exclude=config.exclude, rules=rules, baseline=baseline)
+
+    if args.write_baseline:
+        n = Baseline.dump(report.findings, report.sources, baseline_path)
+        print(f"graftlint: wrote {n} baseline entr(ies) to {baseline_path}")
+        return 0
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.as_dict() for f in report.findings],
+                    "baselined": [f.as_dict() for f in report.baselined],
+                    "suppressed": report.suppressed,
+                    "files": report.files,
+                    "errors": report.errors,
+                    "exit_code": report.exit_code,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in report.findings:
+            print(f.text())
+        for err in report.errors:
+            print(f"error: {err}")
+        print(report.summary())
+    return report.exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,417 @@
+"""Per-module AST context shared by all graftlint rules.
+
+Three analyses every JAX-aware rule needs:
+
+- **alias resolution**: ``import jax.numpy as jnp`` / ``from jax import
+  lax`` / ``from functools import partial`` are folded into one map so a
+  call site resolves to a dotted path (``jnp.dot`` -> ``jax.numpy.dot``)
+  regardless of import style;
+- **traced-scope inference**: which function bodies end up inside an XLA
+  trace. Seeds are decorators (``@jax.jit``, ``@partial(jax.jit, ...)``)
+  and functions passed as arguments to trace-inducing callables
+  (``jax.jit(f)``, ``jax.shard_map(f, ...)``, ``lax.scan(body, ...)``);
+  tracedness then propagates to lexically nested functions and to
+  functions invoked by name from traced code;
+- **jit registry**: names/attributes bound to ``jax.jit``/``pjit``
+  wrappers, with their ``donate_argnums``/``static_argnums``/
+  ``static_argnames`` so call-site rules (GL002/GL003) can map argument
+  positions back to jit semantics.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+__all__ = ["JitEntry", "ModuleContext"]
+
+#: Calling one of these (jax-qualified) traces its function argument.
+TRACE_WRAPPERS = {
+    "jit",
+    "pjit",
+    "pmap",
+    "vmap",
+    "shard_map",
+    "scan",
+    "while_loop",
+    "fori_loop",
+    "cond",
+    "switch",
+    "associative_scan",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "remat",
+    "custom_gradient",
+    "eval_shape",
+    "make_jaxpr",
+    "pallas_call",
+}
+
+#: Attribute reads on a traced array that are trace-time static — they
+#: break value taint (``x.shape[0]`` is a Python int, not a tracer).
+STATIC_ARRAY_ATTRS = {
+    "shape",
+    "ndim",
+    "dtype",
+    "size",
+    "itemsize",
+    "nbytes",
+    "aval",
+    "sharding",
+    "weak_type",
+}
+
+#: Builtins whose result is host-static even on tracer arguments.
+_STATIC_BUILTINS = {"isinstance", "len", "type", "hasattr", "getattr", "callable", "id", "repr", "str"}
+
+#: jax-namespace calls that return host-static METADATA (dtypes, avals,
+#: backend names, device counts), never tracers — branching on them is
+#: ordinary trace-time specialization, not a host sync.
+_STATIC_JAX_CALLS = {
+    "jax.numpy.issubdtype",
+    "jax.numpy.result_type",
+    "jax.numpy.dtype",
+    "jax.dtypes.canonicalize_dtype",
+    "jax.dtypes.issubdtype",
+    "jax.typeof",
+    "jax.eval_shape",
+    "jax.default_backend",
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+    "jax.process_index",
+    "jax.process_count",
+    "jax.tree_util.tree_structure",
+    "jax.tree.structure",
+}
+
+
+@dataclass
+class JitEntry:
+    """One ``jax.jit``/``pjit`` wrapper bound to a name or attribute."""
+
+    kind: str  # "name" | "attr"
+    name: str
+    donate_argnums: tuple[int, ...]
+    donate_argnames: tuple[str, ...]
+    static_argnums: tuple[int, ...]
+    static_argnames: tuple[str, ...]
+    node: ast.AST
+
+    def matches_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if self.kind == "name":
+            return isinstance(f, ast.Name) and f.id == self.name
+        return isinstance(f, ast.Attribute) and f.attr == self.name
+
+
+class ModuleContext:
+    def __init__(self, path: str, src: str, tree: ast.Module) -> None:
+        self.path = path
+        self.src = src
+        self.tree = tree
+        self.lines = src.splitlines()
+        self.parent: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.aliases = self._collect_aliases()
+        self.functions = [
+            n
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+        ]
+        self.defs_by_name: dict[str, list[ast.AST]] = {}
+        for fn in self.functions:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(fn.name, []).append(fn)
+        self.calls = [n for n in ast.walk(tree) if isinstance(n, ast.Call)]
+        self.traced = self._infer_traced()
+        self.jit_registry = self._collect_jit_registry()
+        #: Module-level ``NAME = "literal"`` string constants (axis-name
+        #: indirection like ``DATA_AXIS = "data"``).
+        self.module_str_consts: dict[str, str] = {}
+        for stmt in tree.body:
+            if (
+                isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)
+            ):
+                self.module_str_consts[stmt.targets[0].id] = stmt.value.value
+
+    # -------------------------------------------------------------- aliases
+    def _collect_aliases(self) -> dict[str, str]:
+        aliases: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        aliases[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    full = f"{mod}.{a.name}" if mod else a.name
+                    aliases[a.asname or a.name] = full
+        return aliases
+
+    def resolve(self, node: ast.AST | None) -> str | None:
+        """Dotted path of a Name/Attribute chain through the import
+        aliases; unknown bare names resolve to themselves (dot-free, so
+        jax-qualification checks reject them)."""
+        if isinstance(node, ast.Name):
+            return self.aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value)
+            return None if base is None else f"{base}.{node.attr}"
+        return None
+
+    @staticmethod
+    def is_jax_path(dotted: str | None) -> bool:
+        return bool(dotted) and dotted.split(".", 1)[0] == "jax"
+
+    def is_trace_wrapper(self, node: ast.AST) -> bool:
+        dotted = self.resolve(node)
+        return (
+            self.is_jax_path(dotted)
+            and dotted.rsplit(".", 1)[-1] in TRACE_WRAPPERS
+        )
+
+    def _is_trace_wrapper_decorator(self, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            dotted = self.resolve(dec.func)
+            if dotted in ("functools.partial", "partial"):
+                return bool(dec.args) and self._is_trace_wrapper_decorator(
+                    dec.args[0]
+                )
+            return self.is_trace_wrapper(dec.func)
+        return self.is_trace_wrapper(dec)
+
+    # -------------------------------------------------------- traced scopes
+    def _infer_traced(self) -> set[ast.AST]:
+        traced: set[ast.AST] = set()
+        for fn in self.functions:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+                self._is_trace_wrapper_decorator(d) for d in fn.decorator_list
+            ):
+                traced.add(fn)
+        for call in self.calls:
+            if not self.is_trace_wrapper(call.func):
+                continue
+            cands = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in cands:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif isinstance(arg, ast.Name):
+                    traced.update(self.defs_by_name.get(arg.id, ()))
+        # Propagate: lexical nesting + direct by-name calls from traced code.
+        for _ in range(len(self.functions) + 1):
+            changed = False
+            for fn in self.functions:
+                if fn not in traced and self.in_traced_scope(fn, traced):
+                    traced.add(fn)
+                    changed = True
+            for call in self.calls:
+                if not isinstance(call.func, ast.Name):
+                    continue
+                if self.in_traced_scope(call, traced):
+                    for fn in self.defs_by_name.get(call.func.id, ()):
+                        if fn not in traced:
+                            traced.add(fn)
+                            changed = True
+            if not changed:
+                break
+        return traced
+
+    def enclosing_function(self, node: ast.AST) -> ast.AST | None:
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return cur
+            cur = self.parent.get(cur)
+        return None
+
+    def in_traced_scope(
+        self, node: ast.AST, traced: set[ast.AST] | None = None
+    ) -> bool:
+        traced = self.traced if traced is None else traced
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in traced:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    # --------------------------------------------------------- jit registry
+    def _collect_jit_registry(self) -> list[JitEntry]:
+        entries: list[JitEntry] = []
+
+        def jit_call_kwargs(call: ast.Call) -> dict[str, ast.AST] | None:
+            dotted = self.resolve(call.func)
+            if not (
+                self.is_jax_path(dotted)
+                and dotted.rsplit(".", 1)[-1] in ("jit", "pjit")
+            ):
+                return None
+            return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                kwargs = jit_call_kwargs(node.value)
+                if kwargs is None:
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        kind, name = "name", target.id
+                    elif isinstance(target, ast.Attribute):
+                        kind, name = "attr", target.attr
+                    else:
+                        continue
+                    entries.append(self._make_entry(kind, name, kwargs, node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    dotted = self.resolve(dec.func)
+                    if dotted in ("functools.partial", "partial") and dec.args:
+                        inner = self.resolve(dec.args[0])
+                        if not (
+                            self.is_jax_path(inner)
+                            and inner.rsplit(".", 1)[-1] in ("jit", "pjit")
+                        ):
+                            continue
+                        kwargs = {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+                    else:
+                        kwargs = jit_call_kwargs(dec)
+                        if kwargs is None:
+                            continue
+                    entries.append(
+                        self._make_entry("name", node.name, kwargs, node)
+                    )
+        return entries
+
+    def _make_entry(
+        self, kind: str, name: str, kwargs: dict[str, ast.AST], node: ast.AST
+    ) -> JitEntry:
+        return JitEntry(
+            kind=kind,
+            name=name,
+            donate_argnums=_const_int_tuple(kwargs.get("donate_argnums")),
+            donate_argnames=_const_str_tuple(kwargs.get("donate_argnames")),
+            static_argnums=_const_int_tuple(kwargs.get("static_argnums")),
+            static_argnames=_const_str_tuple(kwargs.get("static_argnames")),
+            node=node,
+        )
+
+    # ----------------------------------------------------------- value taint
+    def expr_level(self, node: ast.AST, levels: dict[str, int]) -> int:
+        """Taint level of an expression's VALUE: 0 = host-static, 1 =
+        WEAK (derived from a traced function's parameters — may be a
+        tracer OR a static Python scalar passed alongside; never worth
+        flagging a branch on), 2 = STRONG (derived from a jax-namespace
+        call — certainly device-resident). Static array attributes
+        (``.shape`` etc.) and shape-reading builtins reset to 0."""
+        if isinstance(node, ast.Name):
+            return levels.get(node.id, 0)
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ARRAY_ATTRS:
+                return 0
+            return self.expr_level(node.value, levels)
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _STATIC_BUILTINS
+            ):
+                return 0
+            dotted = self.resolve(node.func)
+            if self.is_jax_path(dotted):
+                return 0 if dotted in _STATIC_JAX_CALLS else 2
+            if dotted is not None and dotted.split(".", 1)[0] == "numpy":
+                return 0
+            parts = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute):
+                parts.append(node.func)
+            return max(
+                (self.expr_level(p, levels) for p in parts), default=0
+            )
+        if isinstance(node, ast.Lambda):
+            return 0
+        return max(
+            (
+                self.expr_level(child, levels)
+                for child in ast.iter_child_nodes(node)
+                if isinstance(child, ast.expr)
+            ),
+            default=0,
+        )
+
+
+def _const_int_tuple(node: ast.AST | None) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+            ):
+                return ()
+            out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _const_str_tuple(node: ast.AST | None) -> tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return ()
+            out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def assigned_names(node: ast.AST) -> set[str]:
+    """Names bound by an assignment target (tuple-unpacking aware)."""
+    out: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+    return out
+
+
+def stmt_targets(stmt: ast.stmt) -> set[str]:
+    """Names a statement (re)binds at its own level."""
+    if isinstance(stmt, ast.Assign):
+        out: set[str] = set()
+        for t in stmt.targets:
+            out |= assigned_names(t)
+        return out
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return assigned_names(stmt.target)
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return assigned_names(stmt.target)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out = set()
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                out |= assigned_names(item.optional_vars)
+        return out
+    return set()

@@ -1,0 +1,880 @@
+"""graftlint rules GL001–GL008.
+
+Each rule is a callable ``check(ctx) -> Iterator[Finding]`` over a
+:class:`~.context.ModuleContext`. Rules are deliberately heuristic —
+they trade exhaustive dataflow for zero dependencies and speed — and
+every heuristic errs toward silence (skip when unresolvable) so the
+findings that DO fire are worth reading. The escape hatches are inline
+``# graftlint: disable=RULE -- reason`` pragmas and the baseline file.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.context import (
+    ModuleContext,
+    assigned_names,
+    stmt_targets,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.core import Finding
+
+RuleFn = Callable[[ModuleContext], Iterator[Finding]]
+
+_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+#: ``x.<method>()`` calls that force a device->host sync (or a trace-time
+#: concretization error) wherever they appear in traced code.
+_SYNC_METHODS = {"item", "tolist", "numpy"}
+_CONVERTERS = {"float", "int", "bool", "complex"}
+_NUMPY_SYNCERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+_FRESH_LITERALS = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+    ast.GeneratorExp,
+    ast.JoinedStr,
+)
+
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "pbroadcast": 1,
+    "pcast": 1,
+    "psum_scatter": 1,
+    "axis_index": 0,
+}
+
+_TIME_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.perf_counter",
+    "time.perf_counter_ns",
+    "time.monotonic",
+    "time.monotonic_ns",
+    "time.process_time",
+    "time.sleep",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+#: ``jax.random`` helpers that DERIVE keys rather than consume entropy —
+#: reusing a key across these is the sanctioned discipline.
+_NONCONSUMING_RANDOM = {
+    "split",
+    "fold_in",
+    "key",
+    "PRNGKey",
+    "key_data",
+    "wrap_key_data",
+    "key_impl",
+    "clone",
+}
+
+_MUTABLE_CONSTRUCTORS = {
+    "list",
+    "dict",
+    "set",
+    "bytearray",
+    "defaultdict",
+    "OrderedDict",
+    "Counter",
+    "deque",
+}
+
+
+def _finding(
+    ctx: ModuleContext, node: ast.AST, rule: str, name: str, message: str
+) -> Finding:
+    return Finding(
+        path=ctx.path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        rule=rule,
+        name=name,
+        message=message,
+    )
+
+
+def _iter_blocks(stmt: ast.stmt) -> Iterator[list[ast.stmt]]:
+    for field in _BLOCK_FIELDS:
+        block = getattr(stmt, field, None)
+        if block:
+            yield block
+    for handler in getattr(stmt, "handlers", ()):
+        yield handler.body
+
+
+def _walk_expr_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """All nodes of a statement EXCLUDING nested statement bodies and
+    nested function/class definitions (those are separate scopes/steps)."""
+    stack: list[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(
+            node, (ast.stmt, ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ======================================================================= GL001
+def check_host_sync(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL001 host-sync-in-jit-scope.
+
+    Two scopes, one disease:
+
+    - inside TRACED code: ``.item()``/``.tolist()``/``.numpy()``,
+      ``jax.device_get``, ``np.asarray``/``np.array`` of traced values,
+      ``float()``/``int()``/``bool()`` of traced values, and branching
+      (``if``/``while``/ternary) on traced values — all of which either
+      raise a ConcretizationTypeError or silently pin the program to the
+      host at trace time;
+    - inside a HOST step loop (a ``for``/``while`` that invokes a known
+      jit-wrapped callable): ``float()``/``int()``/``.item()``/
+      ``.tolist()``/``np.asarray`` applied to that call's outputs. Each
+      one is a blocking device fetch on the hot path; fetch behind a
+      cadence gate (and suppress with a reason) or hoist it out.
+    """
+    yield from _traced_scope_syncs(ctx)
+    yield from _step_loop_syncs(ctx)
+
+
+def _traced_scope_syncs(ctx: ModuleContext) -> Iterator[Finding]:
+    for fn in ctx.functions:
+        if fn not in ctx.traced:
+            continue
+        args = fn.args
+        params = [
+            a.arg
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+            if a.arg not in ("self", "cls")
+        ]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        # Parameters are WEAK taint (level 1): a traced function's args
+        # can be tracers OR static Python config riding along — flagging
+        # branches on them would drown real findings in shape/flag
+        # validation noise. Values derived from jax.* calls are STRONG
+        # (level 2) and safe to flag.
+        levels = {p: 1 for p in params}
+        body = fn.body if not isinstance(fn, ast.Lambda) else []
+        if isinstance(fn, ast.Lambda):
+            yield from _scan_stmt_exprs(ctx, fn, levels, traced=True)
+            continue
+        yield from _run_taint_block(ctx, body, levels, traced=True)
+
+
+def _run_taint_block(
+    ctx: ModuleContext,
+    stmts: list[ast.stmt],
+    levels: dict[str, int],
+    *,
+    traced: bool,
+    jit_calls: list | None = None,
+) -> Iterator[Finding]:
+    """Order-aware walk of a statement block: flag sync points against
+    the current taint levels, then update them from assignments. Branch
+    taint merges as a per-name max; loop bodies run twice so loop-
+    carried taint is seen."""
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        yield from _scan_stmt_exprs(ctx, stmt, levels, traced=traced)
+        if isinstance(stmt, ast.If):
+            t_body, t_else = dict(levels), dict(levels)
+            yield from _run_taint_block(
+                ctx, stmt.body, t_body, traced=traced, jit_calls=jit_calls
+            )
+            yield from _run_taint_block(
+                ctx, stmt.orelse, t_else, traced=traced, jit_calls=jit_calls
+            )
+            for branch in (t_body, t_else):
+                for k, v in branch.items():
+                    if v > levels.get(k, 0):
+                        levels[k] = v
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            for _ in range(2):
+                for block in _iter_blocks(stmt):
+                    yield from _only_taint_updates(ctx, block, levels, jit_calls)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                iter_lvl = ctx.expr_level(stmt.iter, levels)
+                if iter_lvl:
+                    for n in assigned_names(stmt.target):
+                        levels[n] = max(levels.get(n, 0), iter_lvl)
+            for block in _iter_blocks(stmt):
+                yield from _run_taint_block(
+                    ctx, block, levels, traced=traced, jit_calls=jit_calls
+                )
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith, ast.Try)):
+            for block in _iter_blocks(stmt):
+                yield from _run_taint_block(
+                    ctx, block, levels, traced=traced, jit_calls=jit_calls
+                )
+            continue
+        _update_taint(ctx, stmt, levels, jit_calls)
+
+
+def _only_taint_updates(ctx, block, levels, jit_calls) -> Iterator[Finding]:
+    """Pre-pass a loop body for taint only (no findings) so first-
+    iteration uses of loop-carried values are caught on the real pass."""
+    for stmt in block:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        _update_taint(ctx, stmt, levels, jit_calls)
+        for inner in _iter_blocks(stmt):
+            yield from _only_taint_updates(ctx, inner, levels, jit_calls)
+    return
+    yield  # pragma: no cover — generator protocol
+
+
+def _update_taint(ctx, stmt, levels, jit_calls) -> None:
+    if isinstance(stmt, ast.Assign):
+        lvl = ctx.expr_level(stmt.value, levels)
+        if jit_calls is not None and _is_jit_call(stmt.value, jit_calls):
+            lvl = 2
+        names = set()
+        for t in stmt.targets:
+            names |= assigned_names(t)
+        for n in names:
+            if lvl:
+                levels[n] = lvl
+            else:
+                levels.pop(n, None)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)) and stmt.value is not None:
+        names = assigned_names(stmt.target)
+        lvl = ctx.expr_level(stmt.value, levels)
+        if jit_calls is not None and _is_jit_call(stmt.value, jit_calls):
+            lvl = 2
+        if lvl:
+            for n in names:
+                levels[n] = max(levels.get(n, 0), lvl)
+        elif isinstance(stmt, ast.AnnAssign):
+            for n in names:
+                levels.pop(n, None)
+
+
+def _is_jit_call(node: ast.AST, jit_entries) -> bool:
+    return isinstance(node, ast.Call) and any(
+        e.matches_call(node) for e in jit_entries
+    )
+
+
+def _scan_stmt_exprs(
+    ctx: ModuleContext, stmt: ast.AST, levels: dict[str, int], *, traced: bool
+) -> Iterator[Finding]:
+    rule, name = "GL001", "host-sync-in-jit-scope"
+    where = "traced code" if traced else "the step loop"
+    for node in _walk_expr_nodes(stmt) if isinstance(stmt, ast.stmt) else ast.walk(stmt):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SYNC_METHODS
+                and not node.args
+            ):
+                yield _finding(
+                    ctx,
+                    node,
+                    rule,
+                    name,
+                    f"'.{f.attr}()' forces a blocking device->host sync "
+                    f"inside {where}",
+                )
+                continue
+            dotted = ctx.resolve(f)
+            if traced and dotted == "jax.device_get":
+                yield _finding(
+                    ctx,
+                    node,
+                    rule,
+                    name,
+                    "jax.device_get inside traced code concretizes a tracer",
+                )
+                continue
+            if (
+                dotted in _NUMPY_SYNCERS
+                and node.args
+                and ctx.expr_level(node.args[0], levels) >= 2
+            ):
+                yield _finding(
+                    ctx,
+                    node,
+                    rule,
+                    name,
+                    f"{dotted}() of a device value materializes it on the "
+                    f"host inside {where}",
+                )
+                continue
+            if (
+                isinstance(f, ast.Name)
+                and f.id in _CONVERTERS
+                and len(node.args) == 1
+                and ctx.expr_level(node.args[0], levels) >= 2
+            ):
+                if traced or f.id in ("float", "int"):
+                    yield _finding(
+                        ctx,
+                        node,
+                        rule,
+                        name,
+                        f"{f.id}() of a device value blocks on a device->host "
+                        f"fetch inside {where}",
+                    )
+                continue
+        if traced and isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            test = node.test
+            if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in test.ops
+            ):
+                continue
+            if ctx.expr_level(test, levels) >= 2:
+                yield _finding(
+                    ctx,
+                    node,
+                    rule,
+                    name,
+                    "branching on a traced value concretizes it (host sync "
+                    "or ConcretizationTypeError); use lax.cond/jnp.where",
+                )
+
+
+def _step_loop_syncs(ctx: ModuleContext) -> Iterator[Finding]:
+    if not ctx.jit_registry:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        if ctx.in_traced_scope(node):
+            continue
+        # Only OUTERMOST step loops: inner loops are covered by the walk
+        # starting at the outer one.
+        anc = ctx.parent.get(node)
+        is_nested = False
+        while anc is not None and not isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if isinstance(anc, (ast.For, ast.While)):
+                is_nested = True
+                break
+            anc = ctx.parent.get(anc)
+        if is_nested:
+            continue
+        calls_jit = any(
+            _is_jit_call(c, ctx.jit_registry)
+            for c in ast.walk(node)
+            if isinstance(c, ast.Call)
+        )
+        if not calls_jit:
+            continue
+        yield from _run_taint_block(
+            ctx, node.body, {}, traced=False, jit_calls=ctx.jit_registry
+        )
+
+
+# ======================================================================= GL002
+def check_retrace_hazard(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL002 retrace-hazard.
+
+    (a) ``jax.jit``/``pjit``/``shard_map``/``pmap`` wrappers constructed
+    inside a ``for``/``while`` body: each iteration builds a fresh
+    wrapper with an empty cache, so every step retraces and recompiles.
+    (b) dict/list/set/comprehension/f-string values passed in a
+    ``static_argnums``/``static_argnames`` position of a known jitted
+    callable: unhashable statics TypeError, and per-call-fresh values
+    defeat the cache key, retracing every call.
+    """
+    rule, name = "GL002", "retrace-hazard"
+    wrapset = {"jit", "pjit", "pmap", "shard_map"}
+    for call in ctx.calls:
+        dotted = ctx.resolve(call.func)
+        if not (
+            ctx.is_jax_path(dotted) and dotted.rsplit(".", 1)[-1] in wrapset
+        ):
+            continue
+        anc = ctx.parent.get(call)
+        while anc is not None and not isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                yield _finding(
+                    ctx,
+                    call,
+                    rule,
+                    name,
+                    f"{dotted.rsplit('.', 1)[-1]} wrapper constructed inside "
+                    "a loop: a fresh wrapper has an empty trace cache, so "
+                    "every iteration retraces — hoist it out of the loop",
+                )
+                break
+            anc = ctx.parent.get(anc)
+
+    for entry in ctx.jit_registry:
+        if not (entry.static_argnums or entry.static_argnames):
+            continue
+        for call in ctx.calls:
+            if not entry.matches_call(call) or call is entry.node:
+                continue
+            for pos in entry.static_argnums:
+                if pos < len(call.args) and _is_fresh_or_unhashable(
+                    call.args[pos]
+                ):
+                    yield _finding(
+                        ctx,
+                        call.args[pos],
+                        rule,
+                        name,
+                        f"unhashable/per-call-fresh value in static position "
+                        f"{pos} of jitted '{entry.name}': statics are cache "
+                        "keys — pass a hashable constant (tuple/str/int)",
+                    )
+            for kw in call.keywords:
+                if kw.arg in entry.static_argnames and _is_fresh_or_unhashable(
+                    kw.value
+                ):
+                    yield _finding(
+                        ctx,
+                        kw.value,
+                        rule,
+                        name,
+                        f"unhashable/per-call-fresh value for static argument "
+                        f"'{kw.arg}' of jitted '{entry.name}': statics are "
+                        "cache keys — pass a hashable constant",
+                    )
+
+
+def _is_fresh_or_unhashable(node: ast.AST) -> bool:
+    if isinstance(node, _FRESH_LITERALS):
+        return True
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("list", "dict", "set")
+    )
+
+
+# ======================================================================= GL003
+def check_donation_after_use(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL003 donation-after-use.
+
+    For each call to a jitted callable with ``donate_argnums``: a plain
+    name passed in a donated position hands its buffer to XLA — reading
+    it after the call raises (or silently copies on some backends). Also
+    flags the loop form: a donated name that is never rebound in the
+    loop body is dead by iteration two.
+    """
+    rule, name = "GL003", "donation-after-use"
+    donating = [e for e in ctx.jit_registry if e.donate_argnums or e.donate_argnames]
+    if not donating:
+        return
+    for entry in donating:
+        for call in ctx.calls:
+            if not entry.matches_call(call) or call is entry.node:
+                continue
+            donated: list[tuple[str, ast.AST]] = []
+            for pos in entry.donate_argnums:
+                if pos < len(call.args) and isinstance(call.args[pos], ast.Name):
+                    donated.append((call.args[pos].id, call.args[pos]))
+            for kw in call.keywords:
+                if kw.arg in entry.donate_argnames and isinstance(
+                    kw.value, ast.Name
+                ):
+                    donated.append((kw.value.id, kw.value))
+            if not donated:
+                continue
+            located = _enclosing_stmt(ctx, call)
+            if located is None:
+                continue
+            stmt, block, idx = located
+            rebound = stmt_targets(stmt)
+            for var, arg_node in donated:
+                if var in rebound:
+                    continue
+                use = _load_after(block[idx + 1 :], var)
+                if use is not None:
+                    yield _finding(
+                        ctx,
+                        use,
+                        rule,
+                        name,
+                        f"'{var}' was donated to jitted '{entry.name}' "
+                        f"(line {call.lineno}) — its buffer no longer exists "
+                        "here; rebind the result or drop the donation",
+                    )
+                    continue
+                loop = _enclosing_loop(ctx, stmt)
+                if loop is not None and not _stores_in(loop, var):
+                    yield _finding(
+                        ctx,
+                        arg_node,
+                        rule,
+                        name,
+                        f"'{var}' is donated to jitted '{entry.name}' every "
+                        "loop iteration but never rebound — by iteration two "
+                        "the buffer is gone; rebind it from the call's result",
+                    )
+
+
+def _enclosing_stmt(
+    ctx: ModuleContext, node: ast.AST
+) -> tuple[ast.stmt, list[ast.stmt], int] | None:
+    cur = node
+    while cur is not None:
+        parent = ctx.parent.get(cur)
+        if parent is None:
+            return None
+        if isinstance(cur, ast.stmt):
+            for field, value in ast.iter_fields(parent):
+                if isinstance(value, list) and cur in value:
+                    return cur, value, value.index(cur)
+        cur = parent
+    return None
+
+
+def _load_after(stmts: list[ast.stmt], var: str) -> ast.AST | None:
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Name) and n.id == var:
+                if isinstance(n.ctx, ast.Load):
+                    return n
+                return None  # rebound/deleted first (line granularity)
+    return None
+
+
+def _enclosing_loop(ctx: ModuleContext, stmt: ast.stmt) -> ast.AST | None:
+    cur = ctx.parent.get(stmt)
+    while cur is not None and not isinstance(
+        cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    ):
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            return cur
+        cur = ctx.parent.get(cur)
+    return None
+
+
+def _stores_in(tree: ast.AST, var: str) -> bool:
+    return any(
+        isinstance(n, ast.Name)
+        and n.id == var
+        and isinstance(n.ctx, (ast.Store, ast.Del))
+        for n in ast.walk(tree)
+    )
+
+
+# ======================================================================= GL004
+def check_prng_key_reuse(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL004 prng-key-reuse.
+
+    Within one function, the same key NAME passed to two entropy-
+    consuming ``jax.random.*`` draws without an intervening rebind means
+    correlated randomness (the draws are identical for same shapes).
+    ``split``/``fold_in``/constructors don't consume — deriving many
+    subkeys from one parent is the sanctioned pattern.
+    """
+    for fn in ctx.functions:
+        if isinstance(fn, ast.Lambda):
+            continue
+        yield from _prng_scan_block(ctx, fn.body, {})
+
+
+def _prng_scan_block(
+    ctx: ModuleContext, stmts: list[ast.stmt], consumed: dict[str, ast.Call]
+) -> Iterator[Finding]:
+    rule, name = "GL004", "prng-key-reuse"
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.If):
+            c_body, c_else = dict(consumed), dict(consumed)
+            yield from _prng_scan_block(ctx, stmt.body, c_body)
+            yield from _prng_scan_block(ctx, stmt.orelse, c_else)
+            consumed.clear()
+            consumed.update(c_body)
+            consumed.update(c_else)
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.With, ast.AsyncWith, ast.Try)):
+            for block in _iter_blocks(stmt):
+                yield from _prng_scan_block(ctx, block, consumed)
+            for n in stmt_targets(stmt):
+                consumed.pop(n, None)
+            continue
+        for node in _walk_expr_nodes(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            key = _consumed_key_name(ctx, node)
+            if key is None:
+                continue
+            first = consumed.get(key)
+            if first is not None:
+                yield _finding(
+                    ctx,
+                    node,
+                    rule,
+                    name,
+                    f"PRNG key '{key}' already consumed by jax.random call "
+                    f"on line {first.lineno}; reusing it yields correlated "
+                    "randomness — split/fold_in a fresh subkey",
+                )
+            else:
+                consumed[key] = node
+        for n in stmt_targets(stmt) | (
+            assigned_names(stmt) if isinstance(stmt, ast.Assign) else set()
+        ):
+            consumed.pop(n, None)
+
+
+def _consumed_key_name(ctx: ModuleContext, call: ast.Call) -> str | None:
+    dotted = ctx.resolve(call.func)
+    if not dotted or not dotted.startswith("jax.random."):
+        return None
+    tail = dotted.rsplit(".", 1)[-1]
+    if tail in _NONCONSUMING_RANDOM:
+        return None
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    for kw in call.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value.id
+    return None
+
+
+# ======================================================================= GL005
+def check_collective_axis_drift(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL005 collective-axis-drift.
+
+    Hardcoded axis-name string literals in collective calls are checked
+    against the module's declared axis universe (mesh constructions,
+    PartitionSpec literals, in_specs/out_specs, UPPERCASE string
+    constants). A literal outside the universe is an axis name that
+    drifted from the mesh — a NameError at trace time at best, a wrong
+    reduction group at worst. Modules that declare no axes are skipped
+    (their axis names arrive as parameters)."""
+    rule, name = "GL005", "collective-axis-drift"
+    universe = _axis_universe(ctx)
+    if not universe:
+        return
+    for call in ctx.calls:
+        dotted = ctx.resolve(call.func)
+        if not ctx.is_jax_path(dotted):
+            continue
+        tail = dotted.rsplit(".", 1)[-1]
+        pos = _COLLECTIVE_AXIS_POS.get(tail)
+        if pos is None:
+            continue
+        axis_nodes = []
+        if pos < len(call.args):
+            axis_nodes.append(call.args[pos])
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_nodes.append(kw.value)
+        for axis_node in axis_nodes:
+            for value, lit in _axis_literals(ctx, axis_node):
+                if value not in universe:
+                    yield _finding(
+                        ctx,
+                        lit,
+                        rule,
+                        name,
+                        f"collective '{tail}' names axis '{value}' but this "
+                        f"module's meshes/specs declare {sorted(universe)} — "
+                        "the axis drifted from the mesh",
+                    )
+
+
+def _axis_literals(
+    ctx: ModuleContext, node: ast.AST
+) -> Iterator[tuple[str, ast.AST]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _axis_literals(ctx, elt)
+    elif isinstance(node, ast.Name) and node.id in ctx.module_str_consts:
+        yield ctx.module_str_consts[node.id], node
+
+
+def _axis_universe(ctx: ModuleContext) -> set[str]:
+    universe: set[str] = {
+        v for k, v in ctx.module_str_consts.items() if "AXIS" in k.upper()
+    }
+    mesh_tails = {"Mesh", "make_mesh", "AbstractMesh", "make_device_mesh"}
+    spec_tails = {"PartitionSpec", "NamedSharding"}
+    for call in ctx.calls:
+        dotted = ctx.resolve(call.func)
+        tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+        values = list(call.args) + [kw.value for kw in call.keywords]
+        if tail in mesh_tails:
+            for v in values:
+                universe |= _string_pool(v, dict_keys_only=isinstance(v, ast.Dict))
+        elif tail in spec_tails:
+            for v in values:
+                universe |= _string_pool(v)
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs", "axis_names", "mesh_axes"):
+                universe |= _string_pool(kw.value)
+    return universe
+
+
+def _string_pool(node: ast.AST, dict_keys_only: bool = False) -> set[str]:
+    out: set[str] = set()
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out.add(k.value)
+        if dict_keys_only:
+            return out
+        for v in node.values:
+            out |= _string_pool(v)
+        return out
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+# ======================================================================= GL006
+def check_mutable_default(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL006 mutable-default-arg: ``def f(x, acc=[])`` aliases ONE list
+    across every call — the classic shared-state footgun, doubly nasty
+    under jit where the default is baked into the first trace."""
+    rule, name = "GL006", "mutable-default-arg"
+    for fn in ctx.functions:
+        if isinstance(fn, ast.Lambda):
+            defaults = list(fn.args.defaults)
+        else:
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]
+        for d in defaults:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)):
+                kind = type(d).__name__.lower()
+            elif (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in _MUTABLE_CONSTRUCTORS
+                and not d.args
+                and not d.keywords
+            ):
+                kind = f"{d.func.id}()"
+            else:
+                continue
+            fn_name = getattr(fn, "name", "<lambda>")
+            yield _finding(
+                ctx,
+                d,
+                rule,
+                name,
+                f"mutable default ({kind}) in '{fn_name}' is shared across "
+                "calls; default to None and construct inside the body",
+            )
+
+
+# ======================================================================= GL007
+def check_time_in_trace(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL007 unguarded-time-in-trace: ``time.time()`` (and friends)
+    inside traced code executes ONCE at trace time — the compiled
+    program replays a constant timestamp forever (and ``sleep`` blocks
+    tracing, not the step). Timing belongs on the host around the call,
+    or inside jax.debug.callback/io_callback."""
+    rule, name = "GL007", "unguarded-time-in-trace"
+    for call in ctx.calls:
+        dotted = ctx.resolve(call.func)
+        if dotted not in _TIME_CALLS:
+            continue
+        if not ctx.in_traced_scope(call):
+            continue
+        yield _finding(
+            ctx,
+            call,
+            rule,
+            name,
+            f"{dotted}() inside traced code runs once at trace time and is "
+            "baked into the compiled program as a constant; time on the "
+            "host or via jax.debug.callback",
+        )
+
+
+# ======================================================================= GL008
+def check_dead_import(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL008 dead-import: module-level imports never referenced.
+    ``__init__.py`` files are exempt (imports there are the re-export
+    surface), as are underscore-prefixed bindings (the explicit
+    side-effect-import convention) and ``__future__`` imports."""
+    rule, name = "GL008", "dead-import"
+    if ctx.path.rsplit("/", 1)[-1] == "__init__.py":
+        return
+    used: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+    exported: set[str] = set()
+    for stmt in ctx.tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in stmt.targets
+            )
+        ):
+            exported |= _string_pool(stmt.value)
+    for stmt in ctx.tree.body:
+        imports: list[tuple[str, str]] = []
+        body_stmts = [stmt]
+        if isinstance(stmt, ast.Try):
+            body_stmts = (
+                stmt.body
+                + [s for h in stmt.handlers for s in h.body]
+                + stmt.orelse
+                + stmt.finalbody
+            )
+        for s in body_stmts:
+            if isinstance(s, ast.Import):
+                for a in s.names:
+                    bound = a.asname or a.name.split(".")[0]
+                    imports.append((bound, a.name))
+            elif isinstance(s, ast.ImportFrom):
+                if s.module == "__future__":
+                    continue
+                for a in s.names:
+                    if a.name == "*":
+                        continue
+                    imports.append((a.asname or a.name, a.name))
+            else:
+                continue
+            for bound, orig in imports:
+                if bound.startswith("_") or bound in used or bound in exported:
+                    continue
+                yield _finding(
+                    ctx,
+                    s,
+                    rule,
+                    name,
+                    f"'{bound}' is imported but never used in this module",
+                )
+            imports = []
+
+
+ALL_RULES: dict[str, RuleFn] = {
+    "GL001": check_host_sync,
+    "GL002": check_retrace_hazard,
+    "GL003": check_donation_after_use,
+    "GL004": check_prng_key_reuse,
+    "GL005": check_collective_axis_drift,
+    "GL006": check_mutable_default,
+    "GL007": check_time_in_trace,
+    "GL008": check_dead_import,
+}

@@ -31,7 +31,6 @@ float (they are a rounding error of the weight bytes).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import flax.linen as nn

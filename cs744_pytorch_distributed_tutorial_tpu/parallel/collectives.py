@@ -23,8 +23,6 @@ hand — ``master/part3/part3.py:116``).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 from jax import lax

@@ -26,7 +26,7 @@ the framework inserts the averaging itself.
 
 from __future__ import annotations
 
-from typing import Callable, Protocol
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -31,14 +31,13 @@ from __future__ import annotations
 
 import contextlib
 import math
-from typing import Any, Iterator
+from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from cs744_pytorch_distributed_tutorial_tpu import compat
 from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
@@ -51,7 +50,6 @@ from cs744_pytorch_distributed_tutorial_tpu.data.prefetch import prefetch
 from cs744_pytorch_distributed_tutorial_tpu.models import get_model
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
-    batch_sharding,
     device_stats_sharding,
     host_to_global,
     make_mesh,
@@ -665,6 +663,14 @@ class Trainer:
     # ------------------------------------------------------------------ state
     def init(self, seed: int | None = None) -> TrainState:
         cfg = self.cfg
+        # One-time setup: eager zeros/key creation transfers host
+        # scalars, which an outer transfer_guard("disallow") would
+        # reject. Scope "allow" here; the guard discipline is for the
+        # steady-state step path.
+        with jax.transfer_guard("allow"):
+            return self._init_impl(cfg, seed)
+
+    def _init_impl(self, cfg, seed) -> TrainState:
         rng = jax.random.key(cfg.seed if seed is None else seed)
         sample = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
         state = init_state(self.model, self.tx, rng, sample, self.axis_size)
@@ -959,6 +965,9 @@ class Trainer:
                         or metrics_due
                         or pending_ckpt is not None
                     ):
+                        # graftlint: disable=GL001 -- cadence-gated: only
+                        # reached when a log/metrics/ckpt boundary is due and
+                        # the device work is already fenced.
                         loss = float(metrics["loss"])
                         if watchdog is not None:
                             watchdog.disarm()  # the fetch is the hang point
@@ -973,10 +982,10 @@ class Trainer:
                                 # Same fetch boundary as the loss: the
                                 # device work is already fenced, these are
                                 # ready scalars.
-                                obs_fields["grad_norm"] = float(
+                                obs_fields["grad_norm"] = float(  # graftlint: disable=GL001 -- same gated fetch boundary
                                     metrics["grad_norm"]
                                 )
-                                obs_fields["param_norm"] = float(
+                                obs_fields["param_norm"] = float(  # graftlint: disable=GL001 -- same gated fetch boundary
                                     metrics["param_norm"]
                                 )
                             telemetry.emit_step(
@@ -1147,9 +1156,9 @@ class Trainer:
                 except StopIteration:
                     break
                 m = self.eval_step(state, x, y, mask)
-                total_loss += float(m["loss_sum"])
-                total_correct += int(m["correct"])
-                total_count += int(m["count"])
+                total_loss += float(m["loss_sum"])  # graftlint: disable=GL001 -- eval accumulates on host per batch by design
+                total_correct += int(m["correct"])  # graftlint: disable=GL001 -- eval accumulates on host per batch by design
+                total_count += int(m["count"])  # graftlint: disable=GL001 -- eval accumulates on host per batch by design
             finally:
                 if arm_now:
                     watchdog.disarm()

@@ -1034,10 +1034,14 @@ class LMTrainer:
         def train_step(params, opt_state, tokens, targets, step=0):
             """``step`` keys the dropout mask stream (ignored at
             dropout_rate=0, so existing call sites stay valid); ``fit``
-            threads the real step index."""
-            return mapped_step(
-                params, opt_state, tokens, targets, jnp.int32(step)
-            )
+            threads the real step index. A host int is converted under a
+            scoped transfer_guard("allow"): the 4-byte scalar transfer
+            is deliberate, and callers that keep a device-resident
+            counter pass it through untouched."""
+            if not isinstance(step, jax.Array):
+                with jax.transfer_guard("allow"):
+                    step = jnp.int32(step)
+            return mapped_step(params, opt_state, tokens, targets, step)
 
         self.train_step = train_step
         # The raw jitted step, for AOT lower/compile with explicit
@@ -1070,6 +1074,14 @@ class LMTrainer:
         else replicated. The same global params produce the same model
         function at every tensor_parallel setting (tested)."""
         cfg = self.cfg
+        # Init is one-time setup: eager constant/key creation here may
+        # transfer host scalars, which is fine. Scoping "allow" keeps
+        # init working under an outer transfer_guard("disallow") (the
+        # strict discipline is for the steady-state step path).
+        with jax.transfer_guard("allow"):
+            return self._init_impl(cfg, seed)
+
+    def _init_impl(self, cfg, seed):
         dummy = jnp.zeros(self._local_batch_shape(), jnp.int32)
         variables = self._init_model().init(
             jax.random.key(cfg.seed if seed is None else seed), dummy

@@ -30,6 +30,21 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def strict_jax_guard(request):
+    """Opt-in strictness: tests marked ``@pytest.mark.strict_jax`` run
+    under ``jax.checking_leaks()`` (tracer leaks raise at the leak site)
+    and ``jax.transfer_guard("disallow")`` (any IMPLICIT host<->device
+    transfer raises). Under the guard, fetch results with an explicit
+    ``jax.device_get`` rather than ``float()``/``np.asarray`` — which is
+    exactly the discipline graftlint GL001 enforces statically."""
+    if request.node.get_closest_marker("strict_jax") is None:
+        yield
+        return
+    with jax.checking_leaks(), jax.transfer_guard("disallow"):
+        yield
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

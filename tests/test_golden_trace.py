@@ -14,11 +14,7 @@ numeric drift without masking real changes.
 
 import numpy as np
 import pytest
-from conftest import run_tiny_dp4_steps
-
-# Full engine fit per case — heavy compile; the curve is also pinned to
-# the new-jax AD-inserted-sync path, which the compat shim reroutes.
-pytestmark = pytest.mark.slow
+from conftest import TINY_DP4_CFG, run_tiny_dp4_steps
 
 # Recorded on the 8-virtual-CPU-device harness (4-device data mesh),
 # tiny_cnn, sync="auto", global batch 32, synthetic CIFAR seed 5000,
@@ -27,6 +23,9 @@ GOLDEN = [3.075281, 2.268045, 2.254324, 2.11918, 2.098891, 1.907552,
           1.650272, 1.748724]
 
 
+# Full engine fit — heavy compile; the curve is also pinned to the
+# new-jax AD-inserted-sync path, which the compat shim reroutes.
+@pytest.mark.slow
 def test_part3_loss_curve_matches_golden_trace(mesh4):
     losses, _, _ = run_tiny_dp4_steps(
         "auto",
@@ -46,6 +45,7 @@ GOLDEN_LM = [4.61314, 4.38864, 4.223654, 4.082678, 4.278648, 4.134741,
              4.185895, 4.089676]
 
 
+@pytest.mark.slow
 def test_lm_seq_parallel_loss_curve_matches_golden_trace():
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
@@ -59,3 +59,41 @@ def test_lm_seq_parallel_loss_curve_matches_golden_trace():
     tokens = synthetic_tokens(64, cfg.seq_len, cfg.vocab_size, seed=5000)
     _, _, losses = tr.fit(tokens, steps=len(GOLDEN_LM))
     np.testing.assert_allclose(losses, GOLDEN_LM, rtol=5e-3)
+
+
+def test_cifar_train_step_compiles_exactly_once(mesh4):
+    """Compile-count regression gate: after the warm-up call traces and
+    compiles the CIFAR train step, further steps on same-shaped inputs
+    must hit the jit cache — 0 additional backend compiles. A retrace
+    hazard (unstable static args, fresh wrappers, shifting shapes) shows
+    up here as a nonzero steady-state count, the dynamic twin of
+    graftlint's GL002."""
+    import jax
+
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.obs.system import CompileCounter
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    warm = CompileCounter()
+    cfg = TrainConfig(**TINY_DP4_CFG, sync="allreduce")
+    tr = Trainer(cfg, mesh=mesh4)
+    state = tr.init()
+    ds = synthetic_cifar10(TINY_DP4_CFG["global_batch_size"], 8, seed=0)
+    x, y = shard_global_batch(mesh4, ds.train_images, ds.train_labels)
+    key = jax.random.key(0)
+    state, m = tr.train_step(state, x, y, key)
+    if warm.count == 0:
+        pytest.skip("jax monitoring compile events unavailable")
+
+    steady = CompileCounter()
+    for _ in range(5):
+        state, m = tr.train_step(state, x, y, key)
+    assert np.isfinite(float(m["loss"]))
+    assert steady.count == 0, (
+        f"train_step triggered {steady.count} backend compile(s) after "
+        "warm-up — the step is retracing"
+    )

@@ -1,0 +1,544 @@
+"""graftlint rule tests: one true-positive and one clean fixture per
+rule, plus suppression, baseline, config, and CLI/JSON contract tests.
+
+These run the linter on inline source strings (no jax execution), so
+they are cheap enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis import (
+    Baseline,
+    lint_paths,
+    lint_source,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.cli import main as cli_main
+
+
+def run(src: str, rule: str) -> list:
+    findings, _ = lint_source(textwrap.dedent(src))
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------- GL001
+def test_gl001_item_in_traced_scope():
+    hits = run(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            return y.item()
+        """,
+        "GL001",
+    )
+    assert len(hits) == 1 and ".item()" in hits[0].message
+
+
+def test_gl001_branch_on_derived_traced_value():
+    hits = run(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            s = jnp.sum(x)
+            if s > 0:
+                return s
+            return -s
+        """,
+        "GL001",
+    )
+    assert len(hits) == 1 and "branching" in hits[0].message
+
+
+def test_gl001_step_loop_fetch():
+    hits = run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s)
+
+        def fit(state, steps):
+            losses = []
+            for _ in range(steps):
+                state = step(state)
+                losses.append(float(state))
+            return losses
+        """,
+        "GL001",
+    )
+    assert len(hits) == 1 and "float()" in hits[0].message
+
+
+def test_gl001_clean_branch_on_static_param():
+    # A traced function branching on a plain parameter must NOT fire:
+    # params may be static Python config riding alongside tracers.
+    assert not run(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, smoothing=0.0):
+            if smoothing == 0.0:
+                return jnp.sum(x)
+            return jnp.sum(x) * (1 - smoothing)
+        """,
+        "GL001",
+    )
+
+
+def test_gl001_clean_metadata_predicates():
+    # dtype/backend introspection is host-static even though it is
+    # spelled as a jax call.
+    assert not run(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if not jnp.issubdtype(x.dtype, jnp.integer):
+                raise TypeError("want ints")
+            if x.shape[0] % 2:
+                raise ValueError("want even batch")
+            return x * 2
+        """,
+        "GL001",
+    )
+
+
+# ---------------------------------------------------------------- GL002
+def test_gl002_jit_in_loop():
+    hits = run(
+        """
+        import jax
+
+        def sweep(fns, x):
+            outs = []
+            for fn in fns:
+                g = jax.jit(fn)
+                outs.append(g(x))
+            return outs
+        """,
+        "GL002",
+    )
+    assert len(hits) == 1 and "loop" in hits[0].message
+
+
+def test_gl002_unhashable_static_arg():
+    hits = run(
+        """
+        import jax
+
+        def run(x, cfg):
+            return x
+
+        f = jax.jit(run, static_argnums=(1,))
+
+        def use(x):
+            return f(x, {"lr": 0.1})
+        """,
+        "GL002",
+    )
+    assert len(hits) == 1 and "static" in hits[0].message
+
+
+def test_gl002_clean_hoisted_jit():
+    assert not run(
+        """
+        import jax
+
+        def run(x, cfg):
+            return x
+
+        f = jax.jit(run, static_argnums=(1,))
+
+        def use(x):
+            return f(x, ("lr", 1))
+        """,
+        "GL002",
+    )
+
+
+# ---------------------------------------------------------------- GL003
+def test_gl003_read_after_donation():
+    hits = run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=0)
+
+        def go(state):
+            new = step(state)
+            return state
+        """,
+        "GL003",
+    )
+    assert len(hits) == 1 and "donated" in hits[0].message
+
+
+def test_gl003_donated_never_rebound_in_loop():
+    hits = run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=0)
+
+        def go(state):
+            for _ in range(3):
+                out = step(state)
+            return out
+        """,
+        "GL003",
+    )
+    assert len(hits) == 1 and "never rebound" in hits[0].message
+
+
+def test_gl003_clean_rebinding():
+    assert not run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=0)
+
+        def go(state):
+            for _ in range(3):
+                state = step(state)
+            return state
+        """,
+        "GL003",
+    )
+
+
+# ---------------------------------------------------------------- GL004
+def test_gl004_key_reuse():
+    hits = run(
+        """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (2,))
+            b = jax.random.normal(key, (2,))
+            return a + b
+        """,
+        "GL004",
+    )
+    assert len(hits) == 1 and "already consumed" in hits[0].message
+
+
+def test_gl004_clean_split():
+    assert not run(
+        """
+        import jax
+
+        def sample(key):
+            ka, kb = jax.random.split(key)
+            a = jax.random.normal(ka, (2,))
+            b = jax.random.normal(kb, (2,))
+            return a + b
+        """,
+        "GL004",
+    )
+
+
+# ---------------------------------------------------------------- GL005
+def test_gl005_axis_drift():
+    hits = run(
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        def make(devs):
+            return Mesh(devs, ("data",))
+
+        def allsum(x):
+            return jax.lax.psum(x, "model")
+        """,
+        "GL005",
+    )
+    assert len(hits) == 1 and "'model'" in hits[0].message
+
+
+def test_gl005_clean_known_axis():
+    assert not run(
+        """
+        import jax
+        from jax.sharding import Mesh
+
+        def make(devs):
+            return Mesh(devs, ("data",))
+
+        def allsum(x):
+            return jax.lax.psum(x, "data")
+        """,
+        "GL005",
+    )
+
+
+# ---------------------------------------------------------------- GL006
+def test_gl006_mutable_default():
+    hits = run(
+        """
+        def collect(x, acc=[]):
+            acc.append(x)
+            return acc
+        """,
+        "GL006",
+    )
+    assert len(hits) == 1 and "mutable default" in hits[0].message
+
+
+def test_gl006_clean_none_default():
+    assert not run(
+        """
+        def collect(x, acc=None):
+            acc = [] if acc is None else acc
+            acc.append(x)
+            return acc
+        """,
+        "GL006",
+    )
+
+
+# ---------------------------------------------------------------- GL007
+def test_gl007_time_in_trace():
+    hits = run(
+        """
+        import time
+
+        import jax
+
+        @jax.jit
+        def f(x):
+            t0 = time.perf_counter()
+            return x + t0
+        """,
+        "GL007",
+    )
+    assert len(hits) == 1 and "trace time" in hits[0].message
+
+
+def test_gl007_clean_host_timing():
+    assert not run(
+        """
+        import time
+
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * 2
+
+        def bench(x):
+            t0 = time.perf_counter()
+            f(x).block_until_ready()
+            return time.perf_counter() - t0
+        """,
+        "GL007",
+    )
+
+
+# ---------------------------------------------------------------- GL008
+def test_gl008_dead_import():
+    hits = run(
+        """
+        import os
+        import sys
+
+        print(sys.argv)
+        """,
+        "GL008",
+    )
+    assert len(hits) == 1 and "'os'" in hits[0].message
+
+
+def test_gl008_clean_used_and_exempt():
+    assert not run(
+        """
+        import os
+        import _side_effect_module as _sem
+
+        print(os.sep)
+        """,
+        "GL008",
+    )
+
+
+# ---------------------------------------------------------- suppressions
+def test_trailing_suppression_silences_same_line():
+    src = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            return y.item()  # graftlint: disable=GL001 -- test pragma
+        """
+    )
+    findings, suppressed = lint_source(src)
+    assert not [f for f in findings if f.rule == "GL001"]
+    assert suppressed == 1
+
+
+def test_standalone_suppression_binds_past_comment_block():
+    src = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            # graftlint: disable=GL001 -- pragma on first comment line
+            # with a continuation comment between it and the code.
+            return y.item()
+        """
+    )
+    findings, suppressed = lint_source(src)
+    assert not [f for f in findings if f.rule == "GL001"]
+    assert suppressed == 1
+
+
+def test_disable_file_suppresses_rule_everywhere():
+    src = textwrap.dedent(
+        """
+        # graftlint: disable-file=GL006 -- test pragma
+        def a(x, acc=[]):
+            return acc
+
+        def b(x, acc={}):
+            return acc
+        """
+    )
+    findings, suppressed = lint_source(src)
+    assert not [f for f in findings if f.rule == "GL006"]
+    assert suppressed == 2
+
+
+def test_suppression_is_rule_specific():
+    src = textwrap.dedent(
+        """
+        def a(x, acc=[]):  # graftlint: disable=GL001 -- wrong rule
+            return acc
+        """
+    )
+    findings, _ = lint_source(src)
+    assert [f for f in findings if f.rule == "GL006"]
+
+
+# -------------------------------------------------------------- baseline
+BUGGY = textwrap.dedent(
+    """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        y = jnp.sum(x)
+        return y.item()
+    """
+)
+
+
+def test_baseline_silences_then_resurfaces(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(BUGGY)
+
+    report = lint_paths([str(mod)])
+    assert report.exit_code == 1 and len(report.findings) == 1
+
+    entries = Baseline.fingerprints(report.findings, report.sources)
+    baseline = Baseline(entries)
+    report2 = lint_paths([str(mod)], baseline=baseline)
+    assert report2.exit_code == 0
+    assert not report2.findings and len(report2.baselined) == 1
+
+    # Unrelated edits (line shifts) keep the baseline entry valid...
+    mod.write_text("# a new leading comment\n" + BUGGY)
+    report3 = lint_paths([str(mod)], baseline=baseline)
+    assert report3.exit_code == 0
+
+    # ...but touching the flagged line itself resurfaces the finding.
+    mod.write_text(BUGGY.replace("return y.item()", "return  y.item()"))
+    report4 = lint_paths([str(mod)], baseline=baseline)
+    assert report4.exit_code == 1 and len(report4.findings) == 1
+
+
+def test_baseline_round_trips_through_disk(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(BUGGY)
+    report = lint_paths([str(mod)])
+    bl_path = tmp_path / "baseline.json"
+    Baseline.dump(report.findings, report.sources, bl_path)
+    reloaded = Baseline.load(bl_path)
+    assert lint_paths([str(mod)], baseline=reloaded).exit_code == 0
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_json_output_is_valid(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mod = tmp_path / "mod.py"
+    mod.write_text(BUGGY)
+    rc = cli_main([str(mod), "--format=json", "--no-baseline"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1 and payload["exit_code"] == 1
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "GL001"
+    assert {"path", "line", "col", "rule", "name", "message"} <= finding.keys()
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mod = tmp_path / "ok.py"
+    mod.write_text("import os\n\nprint(os.sep)\n")
+    assert cli_main([str(mod), "--no-baseline"]) == 0
+
+
+def test_cli_select_unknown_rule_is_usage_error(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mod = tmp_path / "ok.py"
+    mod.write_text("x = 1\n")
+    assert cli_main([str(mod), "--select=GL999"]) == 2
+
+
+def test_cli_syntax_error_is_a_finding_exit(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mod = tmp_path / "bad.py"
+    mod.write_text("def f(:\n")
+    assert cli_main([str(mod), "--no-baseline"]) == 1
+
+
+def test_repo_tree_is_lint_clean():
+    """The checked-in tree must stay clean under the checked-in config —
+    the same contract the CI lint job enforces."""
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    if not (repo / "pyproject.toml").is_file():  # installed-package run
+        pytest.skip("source tree not available")
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "cs744_pytorch_distributed_tutorial_tpu.analysis"],
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -71,6 +71,25 @@ def test_seq_parallel_matches_single_device():
     )
 
 
+@pytest.mark.strict_jax
+def test_lm_train_step_strict():
+    """One LM train step on a data x seq mesh under leak checking and a
+    transfer guard: sharding in (host_to_global) and fetching out
+    (device_get) are the only transfers, and both are explicit."""
+    with jax.transfer_guard("allow"):
+        # One-time setup may move host constants to device; only the
+        # step below must be transfer-clean.
+        mesh = make_mesh({"data": 2, "seq": 2}, devices=jax.devices()[:4])
+        cfg = LMConfig(**SMALL, attention_impl="ring",
+                       data_parallel=2, seq_parallel=2)
+        tr = LMTrainer(cfg, mesh=mesh)
+        params, opt_state = tr.init()
+        tokens = synthetic_tokens(8, cfg.seq_len, cfg.vocab_size, seed=9)
+        x, y = tr.shard_batch(tokens[:4])
+    params, opt_state, metrics = tr.train_step(params, opt_state, x, y)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+
+
 def test_lm_params_replicated_after_step():
     mesh = make_mesh({"data": 4, "seq": 2})
     cfg = LMConfig(**SMALL, attention_impl="ring",

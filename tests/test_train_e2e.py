@@ -26,6 +26,45 @@ def _fit(cfg, dataset, mesh):
     return tr.fit(dataset=dataset)
 
 
+@pytest.mark.strict_jax
+def test_cifar_train_step_strict(dataset):
+    """Two CIFAR train steps under leak checking and a transfer guard:
+    the step path must neither leak tracers nor transfer implicitly —
+    all placement is explicit (host_to_global / device_get)."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+
+    with jax.transfer_guard("allow"):
+        # One-time setup (trainer construction, init, data placement)
+        # legitimately moves host constants to device.
+        mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
+        cfg = TrainConfig(
+            model="tiny_cnn", sync="allreduce", num_devices=4,
+            global_batch_size=32, synthetic_data=True,
+        )
+        tr = Trainer(cfg, mesh=mesh)
+        state = tr.init()
+        x, y = shard_global_batch(
+            mesh, dataset.train_images[:32], dataset.train_labels[:32]
+        )
+        # Pre-place the key replicated on the mesh: a single-device key
+        # would be implicitly resharded on every step call.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        key = jax.device_put(
+            jax.random.key(0), NamedSharding(mesh, PartitionSpec())
+        )
+    # Steady state: the step itself and the explicit device_get fetch
+    # run under the outer disallow guard — any implicit transfer on the
+    # hot path fails the test.
+    m = None
+    for _ in range(2):
+        state, m = tr.train_step(state, x, y, key)
+    loss = float(jax.device_get(m["loss"]))
+    assert np.isfinite(loss)
+
+
 def test_dp_training_learns(dataset):
     mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
     cfg = TrainConfig(
