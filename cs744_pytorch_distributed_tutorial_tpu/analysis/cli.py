@@ -4,7 +4,12 @@
 
 Exit codes: 0 clean, 1 findings (or unreadable/syntax-error files),
 2 usage error. ``--write-baseline`` records the current findings as the
-accepted residual and exits 0.
+accepted residual and exits 0. ``--fix`` applies the safe auto-fixes
+(GL008 dead-import removal) before linting and reports what remains.
+
+``python -m ...analysis trace [...]`` dispatches to graftcheck, the
+trace-audit suite over the registered step functions (TA001-TA005,
+``analysis/trace/cli.py``).
 """
 
 from __future__ import annotations
@@ -65,10 +70,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    p.add_argument(
+        "--fix",
+        action="store_true",
+        help="auto-remove GL008 dead imports in the linted files, then lint",
+    )
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "trace":
+        # graftcheck: trace audits over registered step functions. Import
+        # lazily — its CLI must set the JAX platform env before jax loads.
+        from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.cli import (
+            main as trace_main,
+        )
+
+        return trace_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for rid, fn in sorted(ALL_RULES.items()):
@@ -98,6 +118,16 @@ def main(argv: list[str] | None = None) -> int:
         config.disable
     ):
         rules.pop(rid.strip().upper(), None)
+
+    if args.fix:
+        from cs744_pytorch_distributed_tutorial_tpu.analysis.fix import fix_paths
+
+        files_changed, removed = fix_paths(paths, exclude=config.exclude)
+        print(
+            f"graftlint: --fix removed {removed} dead import(s) in "
+            f"{files_changed} file(s)",
+            file=sys.stderr,
+        )
 
     baseline_path = Path(args.baseline or config.baseline)
     baseline = None

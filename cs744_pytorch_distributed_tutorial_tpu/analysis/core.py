@@ -48,9 +48,11 @@ class Finding:
 
 
 # --------------------------------------------------------------- suppressions
+# GLxxx are the AST lint rules; TAxxx are graftcheck's trace-audit rules,
+# which anchor to register_entrypoint() call sites and reuse this machinery.
 _SUPPRESS_RE = re.compile(
     r"graftlint:\s*(?P<kind>disable-file|disable)\s*=\s*"
-    r"(?P<rules>(?:GL\d+|all)(?:\s*,\s*(?:GL\d+|all))*)"
+    r"(?P<rules>(?:(?:GL|TA)\d+|all)(?:\s*,\s*(?:(?:GL|TA)\d+|all))*)"
     r"(?:\s+--\s*(?P<reason>.*))?",
 )
 

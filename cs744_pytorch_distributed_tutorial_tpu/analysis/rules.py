@@ -579,20 +579,37 @@ def _stores_in(tree: ast.AST, var: str) -> bool:
 def check_prng_key_reuse(ctx: ModuleContext) -> Iterator[Finding]:
     """GL004 prng-key-reuse.
 
-    Within one function, the same key NAME passed to two entropy-
-    consuming ``jax.random.*`` draws without an intervening rebind means
-    correlated randomness (the draws are identical for same shapes).
+    Within one function, the same key passed to two entropy-consuming
+    ``jax.random.*`` draws without an intervening rebind means correlated
+    randomness (the draws are identical for same shapes). Keys are
+    tracked by NAME and by constant subscript (``keys[0]`` after
+    ``keys = jax.random.split(key)`` is one key, reused like any other).
+    A consuming draw inside a loop whose key is not re-derived per
+    iteration is the same bug across iterations, and is flagged too.
     ``split``/``fold_in``/constructors don't consume — deriving many
     subkeys from one parent is the sanctioned pattern.
     """
     for fn in ctx.functions:
         if isinstance(fn, ast.Lambda):
             continue
-        yield from _prng_scan_block(ctx, fn.body, {})
+        yield from _prng_scan_block(ctx, fn.body, {}, set())
+
+
+def _pop_rebound(consumed: dict[str, ast.Call], names: set[str]) -> None:
+    """Drop rebound names AND their subscript-derived keys: rebinding
+    ``keys`` invalidates every tracked ``keys[i]``."""
+    for n in names:
+        consumed.pop(n, None)
+        prefix = n + "["
+        for k in [k for k in consumed if k.startswith(prefix)]:
+            consumed.pop(k)
 
 
 def _prng_scan_block(
-    ctx: ModuleContext, stmts: list[ast.stmt], consumed: dict[str, ast.Call]
+    ctx: ModuleContext,
+    stmts: list[ast.stmt],
+    consumed: dict[str, ast.Call],
+    flagged: set[int],
 ) -> Iterator[Finding]:
     rule, name = "GL004", "prng-key-reuse"
     for stmt in stmts:
@@ -600,17 +617,26 @@ def _prng_scan_block(
             continue
         if isinstance(stmt, ast.If):
             c_body, c_else = dict(consumed), dict(consumed)
-            yield from _prng_scan_block(ctx, stmt.body, c_body)
-            yield from _prng_scan_block(ctx, stmt.orelse, c_else)
+            yield from _prng_scan_block(ctx, stmt.body, c_body, flagged)
+            yield from _prng_scan_block(ctx, stmt.orelse, c_else, flagged)
             consumed.clear()
             consumed.update(c_body)
             consumed.update(c_else)
             continue
         if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While, ast.With, ast.AsyncWith, ast.Try)):
+            is_loop = isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
             for block in _iter_blocks(stmt):
-                yield from _prng_scan_block(ctx, block, consumed)
-            for n in stmt_targets(stmt):
-                consumed.pop(n, None)
+                yield from _prng_scan_block(ctx, block, consumed, flagged)
+                if is_loop and block is stmt.body:
+                    # Second pass over the loop body: a key consumed in
+                    # iteration i and not rebound by the loop is consumed
+                    # again in iteration i+1. The loop target itself IS
+                    # rebound per iteration, so drop it first.
+                    _pop_rebound(consumed, stmt_targets(stmt))
+                    yield from _prng_scan_block(
+                        ctx, block, consumed, flagged
+                    )
+            _pop_rebound(consumed, stmt_targets(stmt))
             continue
         for node in _walk_expr_nodes(stmt):
             if not isinstance(node, ast.Call):
@@ -619,7 +645,25 @@ def _prng_scan_block(
             if key is None:
                 continue
             first = consumed.get(key)
-            if first is not None:
+            if first is None:
+                consumed[key] = node
+            elif id(node) in flagged:
+                pass  # already reported (loop rescans revisit nodes)
+            elif first is node:
+                # Only possible on a loop-body rescan: this call is the
+                # FIRST consumer and nothing re-derived the key since.
+                flagged.add(id(node))
+                yield _finding(
+                    ctx,
+                    node,
+                    rule,
+                    name,
+                    f"PRNG key '{key}' is consumed inside a loop without a "
+                    "per-iteration split/fold_in rebind; every iteration "
+                    "draws identical randomness",
+                )
+            else:
+                flagged.add(id(node))
                 yield _finding(
                     ctx,
                     node,
@@ -629,12 +673,25 @@ def _prng_scan_block(
                     f"on line {first.lineno}; reusing it yields correlated "
                     "randomness — split/fold_in a fresh subkey",
                 )
-            else:
-                consumed[key] = node
-        for n in stmt_targets(stmt) | (
-            assigned_names(stmt) if isinstance(stmt, ast.Assign) else set()
-        ):
-            consumed.pop(n, None)
+        _pop_rebound(
+            consumed,
+            stmt_targets(stmt)
+            | (assigned_names(stmt) if isinstance(stmt, ast.Assign) else set()),
+        )
+
+
+def _key_expr_name(node: ast.AST) -> str | None:
+    """Canonical tracking name of a key expression: a bare name, or a
+    constant-index subscript (``keys[0]``, ``keys['enc']``) of one."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.value, ast.Name)
+        and isinstance(node.slice, ast.Constant)
+    ):
+        return f"{node.value.id}[{node.slice.value!r}]"
+    return None
 
 
 def _consumed_key_name(ctx: ModuleContext, call: ast.Call) -> str | None:
@@ -644,11 +701,13 @@ def _consumed_key_name(ctx: ModuleContext, call: ast.Call) -> str | None:
     tail = dotted.rsplit(".", 1)[-1]
     if tail in _NONCONSUMING_RANDOM:
         return None
-    if call.args and isinstance(call.args[0], ast.Name):
-        return call.args[0].id
+    if call.args:
+        named = _key_expr_name(call.args[0])
+        if named is not None:
+            return named
     for kw in call.keywords:
-        if kw.arg == "key" and isinstance(kw.value, ast.Name):
-            return kw.value.id
+        if kw.arg == "key":
+            return _key_expr_name(kw.value)
     return None
 
 
@@ -809,12 +868,15 @@ def check_time_in_trace(ctx: ModuleContext) -> Iterator[Finding]:
 
 
 # ======================================================================= GL008
-def check_dead_import(ctx: ModuleContext) -> Iterator[Finding]:
-    """GL008 dead-import: module-level imports never referenced.
-    ``__init__.py`` files are exempt (imports there are the re-export
-    surface), as are underscore-prefixed bindings (the explicit
-    side-effect-import convention) and ``__future__`` imports."""
-    rule, name = "GL008", "dead-import"
+def iter_dead_imports(
+    ctx: ModuleContext,
+) -> Iterator[tuple[ast.stmt, ast.alias, str]]:
+    """``(import statement, alias, bound name)`` for every module-level
+    import binding never referenced — shared by GL008 and the ``--fix``
+    rewriter (``analysis/fix.py``). Exempt: ``__init__.py`` (imports
+    there are the re-export surface), underscore-prefixed bindings (the
+    explicit side-effect-import convention), ``__all__``-exported names,
+    and ``__future__`` imports."""
     if ctx.path.rsplit("/", 1)[-1] == "__init__.py":
         return
     used: set[str] = set()
@@ -832,7 +894,6 @@ def check_dead_import(ctx: ModuleContext) -> Iterator[Finding]:
         ):
             exported |= _string_pool(stmt.value)
     for stmt in ctx.tree.body:
-        imports: list[tuple[str, str]] = []
         body_stmts = [stmt]
         if isinstance(stmt, ast.Try):
             body_stmts = (
@@ -843,29 +904,36 @@ def check_dead_import(ctx: ModuleContext) -> Iterator[Finding]:
             )
         for s in body_stmts:
             if isinstance(s, ast.Import):
-                for a in s.names:
-                    bound = a.asname or a.name.split(".")[0]
-                    imports.append((bound, a.name))
+                pairs = [(a, a.asname or a.name.split(".")[0]) for a in s.names]
             elif isinstance(s, ast.ImportFrom):
                 if s.module == "__future__":
                     continue
-                for a in s.names:
-                    if a.name == "*":
-                        continue
-                    imports.append((a.asname or a.name, a.name))
+                pairs = [
+                    (a, a.asname or a.name) for a in s.names if a.name != "*"
+                ]
             else:
                 continue
-            for bound, orig in imports:
+            for alias, bound in pairs:
                 if bound.startswith("_") or bound in used or bound in exported:
                     continue
-                yield _finding(
-                    ctx,
-                    s,
-                    rule,
-                    name,
-                    f"'{bound}' is imported but never used in this module",
-                )
-            imports = []
+                yield s, alias, bound
+
+
+def check_dead_import(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL008 dead-import: module-level imports never referenced.
+    ``__init__.py`` files are exempt (imports there are the re-export
+    surface), as are underscore-prefixed bindings (the explicit
+    side-effect-import convention) and ``__future__`` imports.
+    Auto-fixable: ``--fix`` removes the dead bindings in place."""
+    rule, name = "GL008", "dead-import"
+    for stmt, _alias, bound in iter_dead_imports(ctx):
+        yield _finding(
+            ctx,
+            stmt,
+            rule,
+            name,
+            f"'{bound}' is imported but never used in this module",
+        )
 
 
 ALL_RULES: dict[str, RuleFn] = {

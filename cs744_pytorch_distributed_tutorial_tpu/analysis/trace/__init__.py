@@ -1,0 +1,28 @@
+"""graftcheck: trace-level audits of the programs XLA actually sees.
+
+Where graftlint (the AST linter one package up) reads source text, this
+subpackage audits the traced jaxpr and the lowered/compiled executable of
+the REAL train steps: dtype upcasts (TA001), dropped buffer donation
+(TA002), the collective schedule and bytes-on-wire of each sync strategy
+(TA003), closure-captured trace constants (TA004), and dead computation
+(TA005). Entry points self-register from the engine modules
+(``analysis/trace/registry.py``) and the CLI runs as::
+
+    python -m cs744_pytorch_distributed_tutorial_tpu.analysis trace
+"""
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+    TraceEntry,
+    TracedStep,
+    get_entrypoints,
+    load_builtin_entrypoints,
+    register_entrypoint,
+)
+
+__all__ = [
+    "TraceEntry",
+    "TracedStep",
+    "get_entrypoints",
+    "load_builtin_entrypoints",
+    "register_entrypoint",
+]

@@ -1,0 +1,334 @@
+"""The TA-rule audits: checks on traced jaxprs and compiled executables.
+
+========  =============================  =======================================
+rule      name                           what it catches
+========  =============================  =======================================
+TA001     bf16-upcast-matmul             f32 dot/conv reachable from bf16 values
+                                         outside norm/softmax/loss/optimizer
+TA002     dropped-donation               donated arg whose buffer the compiled
+                                         executable does NOT actually alias
+TA003     collective-schedule-mismatch   gradient-collective counts or bytes-on-
+                                         wire disagreeing with the strategy's
+                                         contract / the engine's telemetry
+TA004     large-trace-constant           big arrays closure-captured into the
+                                         trace instead of passed as arguments
+TA005     dead-expensive-eqn             matmuls/collectives whose outputs reach
+                                         no jaxpr output
+========  =============================  =======================================
+
+Findings are anchored to the entry's ``register_entrypoint`` call site, so
+graftlint's inline pragmas (``# graftlint: disable=TA003 -- reason``) and
+the shared baseline machinery apply unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import warnings
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.core import (
+    Finding,
+    Suppressions,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace import jaxpr_utils
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+    TraceEntry,
+    TracedStep,
+)
+
+TRACE_RULES: dict[str, str] = {
+    "TA001": "bf16-upcast-matmul",
+    "TA002": "dropped-donation",
+    "TA003": "collective-schedule-mismatch",
+    "TA004": "large-trace-constant",
+    "TA005": "dead-expensive-eqn",
+}
+
+#: sites where an f32 matmul under mixed precision is deliberate policy:
+#: normalization statistics, softmax/loss numerics, optimizer math
+_TA001_ALLOWLIST = re.compile(
+    r"norm|softmax|cross_entropy|xent|loss|logsumexp|optimi[sz]er"
+    r"|update|sgd|adam",
+    re.IGNORECASE,
+)
+
+#: ``{output}: (param, {index-path}, kind)`` entries in the compiled HLO
+#: header's input_output_alias block — group 1 is the parameter number
+_ALIAS_PARAM_RE = re.compile(r":\s*\(\s*(\d+)\s*,")
+_ALIAS_BLOCK_RE = re.compile(r"input_output_alias=\{(.*?)\}, entry")
+
+
+def _rel(path: str) -> str:
+    try:
+        return Path(path).resolve().relative_to(Path.cwd()).as_posix()
+    except ValueError:
+        return Path(path).as_posix()
+
+
+def _finding(entry: TraceEntry, rule: str, message: str) -> Finding:
+    return Finding(
+        path=_rel(entry.path),
+        line=entry.line,
+        col=1,
+        rule=rule,
+        name=TRACE_RULES[rule],
+        message=f"[{entry.name}] {message}",
+    )
+
+
+def _frames_str(frames: list[tuple[str, str, int]]) -> str:
+    if not frames:
+        return "<no user frames>"
+    return "; ".join(
+        f"{Path(f).name}:{ln} in {fn}" for f, fn, ln in frames[:3]
+    )
+
+
+# ---------------------------------------------------------------------- TA001
+def audit_dtype_upcast(
+    entry: TraceEntry, step: TracedStep, closed_jaxpr
+) -> list[Finding]:
+    out: list[Finding] = []
+    for eqn, mult in jaxpr_utils.tainted_f32_matmuls(closed_jaxpr):
+        frames = jaxpr_utils.eqn_frames(eqn)
+        if any(
+            _TA001_ALLOWLIST.search(fn) or _TA001_ALLOWLIST.search(Path(f).name)
+            for f, fn, _ in frames
+        ):
+            continue
+        shape = tuple(eqn.outvars[0].aval.shape)
+        out.append(
+            _finding(
+                entry,
+                "TA001",
+                f"f32 {eqn.primitive.name} (out shape {shape}, x{mult}) is "
+                f"reachable from bf16 values — a silent 4-byte upcast in a "
+                f"mixed-precision step; traced at {_frames_str(frames)}",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------- TA002
+def audit_donation(
+    entry: TraceEntry, step: TracedStep
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Lower with the step's REAL donate_argnums, then verify in the
+    compiled HLO header that every donated leaf is actually aliased to
+    an output. A donated-but-unaliased buffer means XLA kept a copy —
+    the donation was silently dropped (shape/dtype mismatch, or the
+    value is still used after the "in-place" update)."""
+    with warnings.catch_warnings():
+        # The drop itself warns at lower/compile time; the audit reports
+        # it as a finding instead.
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        lowered = step.fn.lower(*step.args)
+        infos = jax.tree_util.tree_leaves(lowered.args_info)
+        donated = [
+            i for i, a in enumerate(infos) if getattr(a, "donated", False)
+        ]
+        info = {"arg_leaves": len(infos), "donated": len(donated), "aliased": 0}
+        if not donated:
+            return [], info
+        compiled = lowered.compile()
+    header = compiled.as_text().splitlines()[0]
+    m = _ALIAS_BLOCK_RE.search(header)
+    aliased: set[int] = set()
+    if m is not None:
+        aliased = {int(p) for p in _ALIAS_PARAM_RE.findall(m.group(1))}
+    bad_parse = aliased and max(aliased) >= len(infos)
+    info["aliased"] = len(aliased & set(donated))
+    out: list[Finding] = []
+    if bad_parse:
+        out.append(
+            _finding(
+                entry,
+                "TA002",
+                f"could not map input_output_alias params to argument "
+                f"leaves (max param {max(aliased)} >= {len(infos)} leaves); "
+                f"donation audit is unverifiable for this entry",
+            )
+        )
+        return out, info
+    for i in donated:
+        if i in aliased:
+            continue
+        aval = getattr(infos[i], "_aval", None)
+        desc = (
+            f"{getattr(aval, 'dtype', '?')}{tuple(getattr(aval, 'shape', ()))}"
+            if aval is not None
+            else "?"
+        )
+        out.append(
+            _finding(
+                entry,
+                "TA002",
+                f"arg leaf {i} ({desc}) is donated but the compiled "
+                f"executable does not alias it to any output — the "
+                f"donation was dropped and the buffer is double-allocated",
+            )
+        )
+    return out, info
+
+
+# ---------------------------------------------------------------------- TA003
+def audit_collective_schedule(
+    entry: TraceEntry, step: TracedStep, closed_jaxpr
+) -> tuple[list[Finding], dict[str, Any]]:
+    collectives = jaxpr_utils.collect_collectives(closed_jaxpr, step.axis_sizes)
+    counts = jaxpr_utils.schedule_counts(collectives)
+    wire = sum(c.wire_bytes for c in collectives if not c.trivial)
+    info = {
+        "schedule": dict(sorted(counts.items())),
+        "jaxpr_wire_bytes": int(wire),
+        "expected_wire_bytes": (
+            None
+            if step.expected_wire_bytes is None
+            else int(step.expected_wire_bytes)
+        ),
+    }
+    out: list[Finding] = []
+    if step.expected_schedule is not None:
+        expected = {k: v for k, v in step.expected_schedule.items() if v}
+        if counts != expected:
+            out.append(
+                _finding(
+                    entry,
+                    "TA003",
+                    f"gradient-collective schedule {counts} does not match "
+                    f"the '{step.sync}' contract {expected}",
+                )
+            )
+    if step.expected_wire_bytes is not None:
+        expected_b = float(step.expected_wire_bytes)
+        tol = max(0.01 * expected_b, 512.0)
+        if abs(wire - expected_b) > tol:
+            pct = (
+                100.0 * abs(wire - expected_b) / expected_b
+                if expected_b
+                else float("inf")
+            )
+            out.append(
+                _finding(
+                    entry,
+                    "TA003",
+                    f"bytes-on-wire from the jaxpr ({int(wire)}) disagrees "
+                    f"with the engine's sync_wire_bytes accounting "
+                    f"({int(expected_b)}) by {pct:.1f}% (> 1% tolerance) "
+                    f"for sync='{step.sync}'",
+                )
+            )
+    return out, info
+
+
+# ---------------------------------------------------------------------- TA004
+def audit_trace_constants(
+    entry: TraceEntry, step: TracedStep, closed_jaxpr, min_bytes: int = 2**20
+) -> list[Finding]:
+    out: list[Finding] = []
+    for shape, dtype, nbytes in jaxpr_utils.large_trace_constants(
+        closed_jaxpr, min_bytes
+    ):
+        out.append(
+            _finding(
+                entry,
+                "TA004",
+                f"{dtype}{shape} constant ({nbytes / 2**20:.1f} MiB) is "
+                f"baked into the trace — a closure-captured array that "
+                f"should be a step argument (it is re-hashed every trace "
+                f"and duplicated into every executable)",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------- TA005
+def audit_dead_computation(
+    entry: TraceEntry, step: TracedStep, closed_jaxpr
+) -> list[Finding]:
+    out: list[Finding] = []
+    for eqn, mult in jaxpr_utils.dead_expensive_eqns(closed_jaxpr):
+        frames = jaxpr_utils.eqn_frames(eqn)
+        shapes = [tuple(o.aval.shape) for o in eqn.outvars]
+        out.append(
+            _finding(
+                entry,
+                "TA005",
+                f"dead {eqn.primitive.name} (out {shapes}, x{mult}): its "
+                f"outputs reach no jaxpr output, so the work is computed "
+                f"and discarded; traced at {_frames_str(frames)}",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------- entry audit
+def audit_entry(
+    entry: TraceEntry, rules: set[str] | None = None
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Run every selected TA rule against one entry. Returns raw
+    (unsuppressed) findings plus a summary dict for the JSON report."""
+    active = set(TRACE_RULES) if rules is None else rules
+    step = entry.build()
+    closed_jaxpr = jax.make_jaxpr(step.fn)(*step.args)
+    findings: list[Finding] = []
+    summary: dict[str, Any] = {
+        "entry": entry.name,
+        "anchor": f"{_rel(entry.path)}:{entry.line}",
+        "sync": step.sync,
+        "grad_compress": step.grad_compress,
+        "compute_dtype": step.compute_dtype,
+        "axis_sizes": dict(step.axis_sizes),
+        **step.detail,
+    }
+    if "TA001" in active:
+        findings += audit_dtype_upcast(entry, step, closed_jaxpr)
+    if "TA002" in active and step.check_donation:
+        f, dinfo = audit_donation(entry, step)
+        findings += f
+        summary["donation"] = dinfo
+    if "TA003" in active:
+        f, sinfo = audit_collective_schedule(entry, step, closed_jaxpr)
+        findings += f
+        summary.update(sinfo)
+    if "TA004" in active:
+        findings += audit_trace_constants(entry, step, closed_jaxpr)
+    if "TA005" in active:
+        findings += audit_dead_computation(entry, step, closed_jaxpr)
+    summary["findings"] = len(findings)
+    return findings, summary
+
+
+def run_audits(
+    entries: list[TraceEntry], rules: set[str] | None = None
+) -> tuple[list[Finding], int, list[dict[str, Any]], dict[str, str], list[str]]:
+    """Audit all ``entries``. Returns (findings, suppressed_count,
+    summaries, sources, errors) — ``sources`` maps each anchoring file's
+    relative path to its text, for baseline fingerprinting."""
+    findings: list[Finding] = []
+    suppressed = 0
+    summaries: list[dict[str, Any]] = []
+    sources: dict[str, str] = {}
+    errors: list[str] = []
+    for entry in entries:
+        try:
+            raw, summary = audit_entry(entry, rules)
+        except Exception as exc:  # surface as an audit error (exit 2)
+            errors.append(f"{entry.name}: {type(exc).__name__}: {exc}")
+            continue
+        rel = _rel(entry.path)
+        if rel not in sources and os.path.exists(entry.path):
+            sources[rel] = Path(entry.path).read_text()
+        supp = Suppressions(sources.get(rel, ""))
+        kept = [f for f in raw if not supp.is_suppressed(f)]
+        suppressed += len(raw) - len(kept)
+        findings += kept
+        summaries.append(summary)
+    return findings, suppressed, summaries, sources, errors

@@ -1,0 +1,212 @@
+"""graftcheck CLI — the ``trace`` subcommand of the analysis module.
+
+``python -m cs744_pytorch_distributed_tutorial_tpu.analysis trace``
+
+Exit codes mirror graftlint: 0 clean, 1 findings or audit errors (a
+factory that cannot build is a failed audit, not a skipped one), 2 usage
+error. ``--report FILE`` additionally writes the full JSON report (CI
+uploads it as an artifact next to the lint report).
+
+This module configures the JAX platform BEFORE importing jax: audits run
+on CPU with 8 virtual devices so collective schedules are non-degenerate
+on any build agent. Set ``GRAFTCHECK_KEEP_PLATFORM=1`` to skip that and
+audit whatever platform the environment provides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Any
+
+DEFAULT_BASELINE = "graftcheck_baseline.json"
+_VIRTUAL_DEVICES = 8
+
+
+def _configure_platform() -> None:
+    """Force a deterministic 8-device CPU platform.
+
+    Running as ``python -m ...analysis trace`` imports the top-level
+    package (and hence jax) before this runs, but the XLA backend
+    initializes lazily at the first ``jax.devices()`` call — so the env
+    vars still take effect as long as no backend exists yet. If one
+    does (in-process callers like pytest), the caller's platform wins.
+    """
+    if os.environ.get("GRAFTCHECK_KEEP_PLATFORM") == "1":
+        return
+    if "jax" in sys.modules:
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={_VIRTUAL_DEVICES}"
+    if "xla_cpu_enable_concurrency_optimized_scheduler" not in flags:
+        flags += " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="jaxpr/compiled-executable trace audits (TA001-TA005).",
+    )
+    p.add_argument(
+        "entries",
+        nargs="*",
+        help="entrypoint names to audit (default: all registered)",
+    )
+    p.add_argument(
+        "--list-entrypoints",
+        action="store_true",
+        help="list registered entrypoints and exit",
+    )
+    p.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    p.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated TA rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--disable", default=None, help="comma-separated TA rule ids to skip"
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    p.add_argument(
+        "--report",
+        default=None,
+        help="also write the full JSON report to this file",
+    )
+    p.add_argument(
+        "--baseline",
+        default=None,
+        help=f"baseline file of accepted findings (default: {DEFAULT_BASELINE})",
+    )
+    p.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline file",
+    )
+    p.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="record current findings as the accepted baseline and exit 0",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    _configure_platform()
+
+    # Import order matters: everything below pulls in jax, which must see
+    # the platform env vars _configure_platform just set.
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.core import Baseline
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.audits import (
+        TRACE_RULES,
+        run_audits,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        get_entrypoints,
+        load_builtin_entrypoints,
+    )
+
+    if args.list_rules:
+        for rid, name in sorted(TRACE_RULES.items()):
+            print(f"{rid}  {name}")
+        return 0
+
+    rules = set(TRACE_RULES)
+    for flag, keep in ((args.select, True), (args.disable, False)):
+        if not flag:
+            continue
+        named = {r.strip().upper() for r in flag.split(",") if r.strip()}
+        unknown = named - set(TRACE_RULES)
+        if unknown:
+            print(
+                f"graftcheck: unknown rule(s): {sorted(unknown)}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = rules & named if keep else rules - named
+
+    load_builtin_entrypoints()
+    try:
+        entries = get_entrypoints(args.entries or None)
+    except KeyError as e:
+        print(f"graftcheck: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.list_entrypoints:
+        for entry in entries:
+            tags = f" [{','.join(entry.tags)}]" if entry.tags else ""
+            print(f"{entry.name}  {entry.path}:{entry.line}{tags}")
+        return 0
+
+    findings, suppressed, summaries, sources, errors = run_audits(
+        entries, rules
+    )
+
+    baseline_path = Path(args.baseline or DEFAULT_BASELINE)
+    baselined: list[Any] = []
+    if args.write_baseline:
+        n = Baseline.dump(findings, sources, baseline_path)
+        print(f"graftcheck: wrote {n} baseline entr(ies) to {baseline_path}")
+        return 0
+    if not args.no_baseline and baseline_path.is_file():
+        try:
+            baseline = Baseline.load(baseline_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(
+                f"graftcheck: bad baseline {baseline_path}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+        findings, baselined = baseline.split(findings, sources)
+
+    exit_code = 1 if (findings or errors) else 0
+    payload = {
+        "findings": [f.as_dict() for f in findings],
+        "baselined": [f.as_dict() for f in baselined],
+        "suppressed": suppressed,
+        "entries": summaries,
+        "errors": errors,
+        "exit_code": exit_code,
+    }
+    if args.report:
+        Path(args.report).write_text(json.dumps(payload, indent=2) + "\n")
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+        return exit_code
+
+    for f in findings:
+        print(f.text())
+    for err in errors:
+        print(f"error: {err}")
+    n_audited = len(summaries)
+    bits = [f"{n_audited} entrypoint(s) audited", f"{len(findings)} finding(s)"]
+    if baselined:
+        bits.append(f"{len(baselined)} baselined")
+    if suppressed:
+        bits.append(f"{suppressed} suppressed")
+    if errors:
+        bits.append(f"{len(errors)} error(s)")
+    print("graftcheck: " + ", ".join(bits))
+    return exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

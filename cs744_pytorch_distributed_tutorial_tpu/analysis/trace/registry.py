@@ -1,0 +1,124 @@
+"""Entry-point registry for graftcheck.
+
+The trace audits need REAL step functions — the exact jitted callables
+the engines run, with their real ``donate_argnums``, sync strategy and
+mesh — not reconstructions that could drift from production. So the
+engine modules self-register factories at import time::
+
+    # at the bottom of train/engine.py
+    register_entrypoint("cifar", _graftcheck_entry)
+
+A factory is called lazily by the CLI (building a Trainer is not free)
+and returns a :class:`TracedStep` bundling the jitted fn, example args,
+and the engine's own expectations (schedule, wire bytes) for TA003 to
+cross-check against the trace.
+
+Registration captures the CALLER's file and line so that graftlint-style
+``# graftlint: disable=TA00x`` pragmas placed on the registering line
+suppress findings for that entry — trace findings have no single source
+line of their own, so the registration site is their anchor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from typing import Any, Callable
+
+
+@dataclasses.dataclass
+class TracedStep:
+    """One auditable step function plus everything the audits need.
+
+    ``fn`` must be the jitted callable (``jax.jit(...)`` result) so that
+    TA002 can ``.lower()`` it and read ``args_info``/compiled aliasing;
+    ``args`` are example inputs of the real shapes/dtypes/shardings.
+    """
+
+    name: str
+    fn: Callable[..., Any]
+    args: tuple[Any, ...]
+    #: mesh axis sizes, e.g. ``{"data": 4}`` — used to size collective groups
+    axis_sizes: dict[str, int]
+    #: sync strategy name (``parallel.sync.SYNC_STRATEGIES`` key) or None
+    sync: str | None = None
+    grad_compress: str = "none"
+    compute_dtype: str = "float32"
+    #: expected gradient-collective counts per canonical class, already
+    #: multiplied by sync units and syncs-per-step; None skips the
+    #: schedule assertion (strategy has no fixed contract, e.g. "none")
+    expected_schedule: dict[str, int] | None = None
+    #: the engine's analytic per-device bytes-on-wire per step (what it
+    #: logs as ``sync_wire_bytes``); None skips the bytes cross-check
+    expected_wire_bytes: float | None = None
+    #: whether this step donates buffers (enables TA002)
+    check_donation: bool = True
+    #: extra context echoed into the JSON report
+    detail: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEntry:
+    """A registered (but not yet built) entry point."""
+
+    name: str
+    factory: Callable[[], TracedStep]
+    path: str
+    line: int
+    tags: tuple[str, ...] = ()
+
+    def build(self) -> TracedStep:
+        step = self.factory()
+        if step.name != self.name:
+            step = dataclasses.replace(step, name=self.name)
+        return step
+
+
+_REGISTRY: dict[str, TraceEntry] = {}
+
+
+def register_entrypoint(
+    name: str,
+    factory: Callable[[], TracedStep],
+    *,
+    tags: tuple[str, ...] = (),
+) -> TraceEntry:
+    """Register ``factory`` under ``name``, anchoring findings to the
+    caller's file/line. Re-registering a name replaces the old entry, so
+    module re-imports are harmless."""
+    frame = sys._getframe(1)
+    entry = TraceEntry(
+        name=name,
+        factory=factory,
+        path=frame.f_code.co_filename,
+        line=frame.f_lineno,
+        tags=tuple(tags),
+    )
+    _REGISTRY[name] = entry
+    return entry
+
+
+def get_entrypoints(names: list[str] | None = None) -> list[TraceEntry]:
+    """Registered entries, insertion-ordered; ``names`` filters and
+    raises on unknowns so CI typos fail loudly."""
+    if names is None:
+        return list(_REGISTRY.values())
+    missing = [n for n in names if n not in _REGISTRY]
+    if missing:
+        known = ", ".join(sorted(_REGISTRY)) or "<none>"
+        raise KeyError(
+            f"unknown trace entrypoint(s) {missing}; registered: {known}"
+        )
+    return [_REGISTRY[n] for n in names]
+
+
+def load_builtin_entrypoints() -> None:
+    """Register the engines' entry points. Import errors propagate (a
+    broken engine should fail the audit, not silently shrink its
+    coverage). Registration is re-run explicitly — not left to import
+    side effects — so the call is idempotent even if something cleared
+    the registry after the modules were first imported."""
+    from cs744_pytorch_distributed_tutorial_tpu.train import engine, lm
+
+    engine._register_trace_entries()
+    lm._register_lm_trace_entries()

@@ -19,7 +19,10 @@ Record schema (all records are flat JSON objects):
 
 - ``kind="step"``: ``run, step, time, loss, grad_norm, param_norm,
   lr, grad_sync_bytes, step_time_s, mfu, ...`` (engine-specific
-  extras such as ``moe_aux`` ride along).
+  extras such as ``moe_aux`` ride along). ``grad_sync_bytes`` is
+  audited: graftcheck's TA003 recomputes bytes-on-wire from the traced
+  step's collective eqns and fails CI if the analytic accounting
+  drifts more than 1% from the trace (``analysis/trace/``).
 - ``kind="system"``: HBM + compile counters (see ``obs/system.py``).
 - ``kind="event"``: one-off markers — watchdog firings, divergence
   verdicts, eval results, speculative-decode stats.

@@ -379,6 +379,7 @@ def sync_wire_bytes(
     grad_compress: str = "none",
     *,
     quant_chunk: int = QUANT_CHUNK,
+    bucket_bytes: int | None = None,
 ) -> int:
     """Per-step gradient-sync payload bytes of the ACTIVE configuration.
 
@@ -386,13 +387,113 @@ def sync_wire_bytes(
     resolved through the same knobs the engines resolve: ``name`` is the
     ``cfg.sync`` strategy, and ``grad_compress="int8"`` reroutes the wire
     math to the quantized payload regardless of the base strategy —
-    exactly what ``sync_grads_compressed`` does to the collectives. The
-    telemetry layer records this number as ``grad_sync_bytes`` per step.
+    exactly what ``sync_grads_compressed`` does to the collectives. Pass
+    the engine's ``bucket_bytes`` so the int8 paths count their padded
+    payload exactly (graftcheck TA003 holds this number to within 1% of
+    the bytes derived from the traced jaxpr). The telemetry layer records
+    this number as ``grad_sync_bytes`` per step.
     """
     if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
         strategy = "int8_ring" if name in ("ring", "int8_ring") else "int8_allreduce"
     else:
         strategy = name
     return B.sync_bytes_per_step(
-        params, strategy, axis_size, quant_chunk=quant_chunk
+        params,
+        strategy,
+        axis_size,
+        quant_chunk=quant_chunk,
+        bucket_bytes=bucket_bytes,
     )
+
+
+# ----------------------------------------------------- schedule contracts
+def sync_units(
+    params,
+    name: str,
+    axis_size: int,
+    *,
+    bucket_bytes: int | None = DEFAULT_BUCKET_BYTES,
+    grad_compress: str = "none",
+) -> int:
+    """How many sync UNITS one pass over ``params`` issues collectives
+    for: buckets where the strategy coalesces (``allreduce``/``ring``
+    with bucketing on, every int8 path, bucketed zero1/fsdp), leaves
+    everywhere else. This mirrors the routing in :func:`sync_grads`,
+    :func:`sync_grads_compressed` and ``zero.Zero1SGD.apply`` exactly —
+    it is the unit count :func:`expected_collective_schedule` scales by.
+    """
+    leaves = len(jax.tree.leaves(params))
+    if axis_size <= 1 or name == "none":
+        return leaves
+    if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
+        layout = B.bucket_layout(
+            params, bucket_bytes or B.DEFAULT_BUCKET_BYTES, rows=0
+        )
+        return len(layout.bucket_cols)
+    if name in ("zero1", "fsdp"):
+        if bucket_bytes:
+            layout = B.bucket_layout(params, bucket_bytes, rows=axis_size)
+            return len(layout.bucket_cols)
+        return leaves
+    if bucket_bytes and name in _BUCKETED:
+        rows = axis_size if name == "ring" else 0
+        layout = B.bucket_layout(params, bucket_bytes, rows=rows)
+        return len(layout.bucket_cols)
+    return leaves
+
+
+def expected_collective_schedule(
+    name: str,
+    axis_size: int,
+    units: int,
+    *,
+    grad_compress: str = "none",
+    syncs_per_step: int = 1,
+) -> dict[str, int] | None:
+    """The gradient-collective contract of one train step: canonical
+    collective class -> count, for ``units`` sync units synced
+    ``syncs_per_step`` times. graftcheck's TA003 asserts the traced jaxpr
+    contains EXACTLY this multiset of non-trivial (payload beyond a
+    scalar, group beyond one device) collectives — a drifted count means
+    a strategy regressed into extra hops or silently stopped syncing.
+
+    Counts per unit, ``n = axis_size``:
+
+    - ``allreduce``/``auto``: 1 psum;
+    - ``ring``/``p2p_star``: 2(n-1) ppermutes (reduce-scatter +
+      all-gather hop sequences; the star serializes the same hop count
+      through rank 0);
+    - ``gather_scatter``: 1 all_gather (the mean + broadcast stay local);
+    - ``int8_allreduce``: 2 all_to_alls + 2 all_gathers (codes and
+      scales travel separately in each phase);
+    - ``int8_ring``: 4(n-1) ppermutes (codes + scales per hop, both
+      phases);
+    - ``zero1``/``fsdp``: delegated to ``parallel.zero``'s own contract;
+    - ``none`` (or 1-sized axis): no collectives.
+
+    Returns None for unknown names (no contract to assert).
+    """
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
+        fsdp_collective_schedule,
+        zero1_collective_schedule,
+    )
+
+    n = int(axis_size)
+    u = int(units) * int(syncs_per_step)
+    if name == "none" or n <= 1:
+        return {}
+    if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
+        if name in ("ring", "int8_ring"):
+            return {"ppermute": 4 * (n - 1) * u}
+        return {"all_to_all": 2 * u, "all_gather": 2 * u}
+    if name in ("allreduce", "auto"):
+        return {"psum": u}
+    if name in ("ring", "p2p_star"):
+        return {"ppermute": 2 * (n - 1) * u}
+    if name == "gather_scatter":
+        return {"all_gather": u}
+    if name == "zero1":
+        return zero1_collective_schedule(u, n)
+    if name == "fsdp":
+        return fsdp_collective_schedule(u, n)
+    return None

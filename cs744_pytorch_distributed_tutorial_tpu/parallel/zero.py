@@ -204,6 +204,24 @@ def _gather_flat(shards, shape_tree, axis_name: str):
     return jax.tree.map(leaf, shards, shape_tree)
 
 
+def zero1_collective_schedule(units: int, axis_size: int) -> dict[str, int]:
+    """Gradient-collective contract of one ZeRO-1 step: one psum_scatter
+    (primitive ``reduce_scatter``) delivering each device's chunk of the
+    mean gradient, plus one all_gather returning the parameter deltas —
+    per sync UNIT (bucket when ``bucket_bytes`` is set, leaf otherwise).
+    graftcheck's TA003 asserts the traced jaxpr matches this."""
+    if axis_size <= 1:
+        return {}
+    return {"reduce_scatter": units, "all_gather": units}
+
+
+def fsdp_collective_schedule(units: int, axis_size: int) -> dict[str, int]:
+    """FSDP's contract: one parameter all_gather per unit before compute,
+    whose AD transpose is one reduce_scatter of the gradients — the same
+    pair count as ZeRO-1, issued on the other side of the matmuls."""
+    return zero1_collective_schedule(units, axis_size)
+
+
 class Zero1SGD:
     """SGD(momentum, weight-decay) with data-axis-sharded momentum.
 

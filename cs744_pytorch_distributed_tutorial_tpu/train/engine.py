@@ -769,7 +769,11 @@ class Trainer:
         # reduce-scatter into the single sharded update.
         syncs_per_step = 1 if (self._compress or self._zero1) else cfg.accum_steps
         wire_bytes = syncs_per_step * sync_wire_bytes(
-            state.params, cfg.sync, self.axis_size, cfg.grad_compress
+            state.params,
+            cfg.sync,
+            self.axis_size,
+            cfg.grad_compress,
+            bucket_bytes=self._bucket_bytes,
         )
         sched = make_schedule(cfg)
         lr_at = (
@@ -1169,3 +1173,111 @@ class Trainer:
             "count": total_count,
             "accuracy": total_correct / max(total_count, 1),
         }
+
+
+# ------------------------------------------------------------------ graftcheck
+def make_trace_entry(**overrides):
+    """A graftcheck ``TracedStep`` around this engine's REAL jitted
+    ``train_step`` (same ``shard_map``, same ``donate_argnums``): a tiny
+    model on a small mesh with one synthetic batch, carrying the engine's
+    own collective-schedule contract and wire-byte accounting for TA003
+    to cross-check against the traced jaxpr. ``overrides`` are
+    ``TrainConfig`` fields — the audit tests sweep ``sync=`` through
+    every strategy with exactly this function.
+    """
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        TracedStep,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+        expected_collective_schedule,
+        sync_units,
+    )
+
+    ndev = min(4, len(jax.devices()))
+    kw: dict[str, Any] = dict(
+        model="tiny_cnn",
+        num_devices=ndev,
+        global_batch_size=8 * ndev,
+        synthetic_data=True,
+        synthetic_train_size=8 * ndev,
+        synthetic_test_size=8 * ndev,
+        sync="allreduce",
+    )
+    kw.update(overrides)
+    cfg = TrainConfig(**kw)
+    mesh = make_mesh({DATA_AXIS: ndev}, devices=jax.devices()[:ndev])
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init()
+    ds = _load_dataset(cfg)
+    x, y = shard_global_batch(
+        mesh,
+        ds.train_images[: cfg.global_batch_size],
+        ds.train_labels[: cfg.global_batch_size],
+    )
+    key = jax.random.key(0)
+
+    syncs_per_step = (
+        1 if (trainer._compress or trainer._zero1) else cfg.accum_steps
+    )
+    if cfg.sync in ("auto", "none") and not compat.LEGACY_SHARD_MAP:
+        # Framework-inserted sync: the averaging collectives come from the
+        # AD transpose, not a hand-traced strategy — no fixed contract.
+        schedule = None
+    else:
+        # Mirrors _build_steps' explicit_sync rerouting of auto/none.
+        effective = (
+            "allreduce" if cfg.sync in ("auto", "none") else cfg.sync
+        )
+        units = sync_units(
+            state.params,
+            effective,
+            trainer.axis_size,
+            bucket_bytes=trainer._bucket_bytes,
+            grad_compress=cfg.grad_compress,
+        )
+        schedule = expected_collective_schedule(
+            effective,
+            trainer.axis_size,
+            units,
+            grad_compress=cfg.grad_compress,
+            syncs_per_step=syncs_per_step,
+        )
+    wire_bytes = syncs_per_step * sync_wire_bytes(
+        state.params,
+        cfg.sync,
+        trainer.axis_size,
+        cfg.grad_compress,
+        bucket_bytes=trainer._bucket_bytes,
+    )
+    return TracedStep(
+        name="cifar",
+        fn=trainer.train_step,
+        args=(state, x, y, key),
+        axis_sizes={DATA_AXIS: trainer.axis_size},
+        sync=cfg.sync,
+        grad_compress=cfg.grad_compress,
+        compute_dtype=cfg.compute_dtype,
+        expected_schedule=schedule,
+        expected_wire_bytes=float(wire_bytes),
+        check_donation=True,
+        detail={"model": cfg.model, "accum_steps": cfg.accum_steps},
+    )
+
+
+def _cifar_int8_entry():
+    return make_trace_entry(sync="int8_allreduce")
+
+
+def _register_trace_entries() -> None:
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        register_entrypoint,
+    )
+
+    register_entrypoint("cifar", make_trace_entry, tags=("cifar",))
+    register_entrypoint("cifar-int8", _cifar_int8_entry, tags=("cifar", "int8"))
+
+
+_register_trace_entries()

@@ -1207,7 +1207,12 @@ class LMTrainer:
             dp_strategy = "zero1"
         else:
             dp_strategy = "allreduce"
-        wire_bytes = sync_wire_bytes(params, dp_strategy, self.data_size)
+        wire_bytes = sync_wire_bytes(
+            params,
+            dp_strategy,
+            self.data_size,
+            bucket_bytes=self._bucket_bytes,
+        )
         sched = make_schedule(cfg)
         lr_at = (
             (lambda s: float(sched))
@@ -1359,3 +1364,104 @@ class LMTrainer:
                 ckpt.close()
             telemetry.close()
         return params, opt_state, losses
+
+
+# ------------------------------------------------------------------ graftcheck
+def make_lm_trace_entry(**overrides):
+    """A graftcheck ``TracedStep`` around the LM engine's real
+    ``jitted_train_step`` (the raw jitted ``shard_map`` with
+    ``donate_argnums=(0, 1)``): a tiny transformer on the configured
+    mesh, carrying the DP-sync contract and the same wire-byte
+    accounting ``fit`` writes to telemetry. ``overrides`` are
+    ``LMConfig`` fields — the audit tests sweep the DP modes
+    (allreduce / int8 / zero1 / fsdp) through this function.
+    """
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        TracedStep,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+        expected_collective_schedule,
+        sync_units,
+        sync_wire_bytes,
+    )
+
+    ndev = min(4, len(jax.devices()))
+    kw: dict[str, Any] = dict(
+        vocab_size=64,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=16,
+        seq_len=16,
+        global_batch_size=2 * ndev,
+        data_parallel=ndev,
+        seq_parallel=1,
+        attention_impl="dense",
+    )
+    kw.update(overrides)
+    cfg = LMConfig(**kw)
+    trainer = LMTrainer(cfg)
+    params, opt_state = trainer.init()
+    tokens = jnp.zeros((cfg.global_batch_size, cfg.seq_len), jnp.int32)
+    targets = jnp.zeros_like(tokens)
+    step = jnp.int32(0)
+
+    # Mirror fit()'s dp_strategy resolution and wire accounting exactly.
+    if trainer._compress:
+        dp_strategy = "int8_allreduce"
+    elif cfg.fsdp:
+        dp_strategy = "fsdp"
+    elif trainer._zero1_opt is not None:
+        dp_strategy = "zero1"
+    else:
+        dp_strategy = "allreduce"
+    # The LM sync is per-LEAF for every uncompressed path (sync_grad /
+    # Zero1Adam map over leaves); only the int8 path buckets.
+    units = sync_units(
+        params,
+        dp_strategy,
+        trainer.data_size,
+        bucket_bytes=trainer._bucket_bytes if trainer._compress else None,
+        grad_compress=cfg.grad_compress,
+    )
+    schedule = expected_collective_schedule(
+        dp_strategy,
+        trainer.data_size,
+        units,
+        grad_compress=cfg.grad_compress,
+    )
+    wire_bytes = sync_wire_bytes(
+        params,
+        dp_strategy,
+        trainer.data_size,
+        bucket_bytes=trainer._bucket_bytes,
+    )
+    return TracedStep(
+        name="lm",
+        fn=trainer.jitted_train_step,
+        args=(params, opt_state, tokens, targets, step),
+        axis_sizes=dict(trainer.mesh.shape),
+        sync=dp_strategy,
+        grad_compress=cfg.grad_compress,
+        compute_dtype=cfg.compute_dtype,
+        expected_schedule=schedule,
+        expected_wire_bytes=float(wire_bytes),
+        check_donation=True,
+        detail={
+            "layers": cfg.num_layers,
+            "d_model": cfg.d_model,
+            "dp": trainer.data_size,
+        },
+    )
+
+
+def _register_lm_trace_entries() -> None:
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        register_entrypoint,
+    )
+
+    register_entrypoint("lm", make_lm_trace_entry, tags=("lm",))
+
+
+_register_lm_trace_entries()

@@ -251,6 +251,101 @@ def test_gl004_clean_split():
     )
 
 
+def test_gl004_subscript_reuse():
+    hits = run(
+        """
+        import jax
+
+        def sample(key):
+            keys = jax.random.split(key, 4)
+            a = jax.random.normal(keys[0], (2,))
+            b = jax.random.normal(keys[0], (2,))
+            return a + b
+        """,
+        "GL004",
+    )
+    assert len(hits) == 1 and "'keys[0]'" in hits[0].message
+
+
+def test_gl004_clean_distinct_subscripts():
+    assert not run(
+        """
+        import jax
+
+        def sample(key):
+            keys = jax.random.split(key, 4)
+            a = jax.random.normal(keys[0], (2,))
+            b = jax.random.normal(keys[1], (2,))
+            return a + b
+        """,
+        "GL004",
+    )
+
+
+def test_gl004_subscript_rebind_resets_tracking():
+    assert not run(
+        """
+        import jax
+
+        def sample(key):
+            keys = jax.random.split(key, 4)
+            a = jax.random.normal(keys[0], (2,))
+            keys = jax.random.split(keys[3], 4)
+            b = jax.random.normal(keys[0], (2,))
+            return a + b
+        """,
+        "GL004",
+    )
+
+
+def test_gl004_loop_body_reuse():
+    hits = run(
+        """
+        import jax
+
+        def sample(key, xs):
+            out = []
+            for x in xs:
+                out.append(jax.random.normal(key, (2,)) + x)
+            return out
+        """,
+        "GL004",
+    )
+    assert len(hits) == 1 and "inside a loop" in hits[0].message
+
+
+def test_gl004_clean_loop_fold_in():
+    assert not run(
+        """
+        import jax
+
+        def sample(key, xs):
+            out = []
+            for i, x in enumerate(xs):
+                k = jax.random.fold_in(key, i)
+                out.append(jax.random.normal(k, (2,)) + x)
+            return out
+        """,
+        "GL004",
+    )
+
+
+def test_gl004_clean_loop_carried_split():
+    assert not run(
+        """
+        import jax
+
+        def sample(key, xs):
+            out = []
+            for x in xs:
+                key, sub = jax.random.split(key)
+                out.append(jax.random.normal(sub, (2,)) + x)
+            return out
+        """,
+        "GL004",
+    )
+
+
 # ---------------------------------------------------------------- GL005
 def test_gl005_axis_drift():
     hits = run(
@@ -518,6 +613,138 @@ def test_cli_syntax_error_is_a_finding_exit(tmp_path, capsys, monkeypatch):
     mod = tmp_path / "bad.py"
     mod.write_text("def f(:\n")
     assert cli_main([str(mod), "--no-baseline"]) == 1
+
+
+# ----------------------------------------------------------------- --fix
+def fix(src: str) -> tuple[str, int]:
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.fix import fix_source
+
+    return fix_source(textwrap.dedent(src), "mod.py")
+
+
+def test_fix_removes_dead_import():
+    new, n = fix(
+        """
+        import os
+        import json
+
+        print(json.dumps({}))
+        """
+    )
+    assert n == 1
+    assert "import os" not in new and "import json" in new
+
+
+def test_fix_rewrites_partially_dead_from_import():
+    new, n = fix(
+        """
+        from os.path import join, basename
+
+        print(join("a", "b"))
+        """
+    )
+    assert n == 1
+    assert "from os.path import join" in new and "basename" not in new
+
+
+def test_fix_cascades_to_fixpoint_and_is_idempotent():
+    src = """
+    import json
+    import os
+
+    x = json.dumps({})
+    """
+    new, n = fix(src)
+    assert n == 1 and "import os" not in new
+    again, n2 = fix(new)
+    assert n2 == 0 and again == new
+
+
+def test_fix_preserves_exempt_imports():
+    src = """
+    from __future__ import annotations
+
+    import os as _side_effect
+    import sys
+
+    __all__ = ["sys"]
+    """
+    new, n = fix(src)
+    assert n == 0 and new == textwrap.dedent(src)
+
+
+def test_fix_skips_try_nested_imports():
+    src = """
+    try:
+        import fancy_dep
+    except ImportError:
+        fancy_dep = None
+    """
+    new, n = fix(src)
+    assert n == 0 and new == textwrap.dedent(src)
+
+
+def test_fix_respects_suppression_pragma():
+    src = "import os  # graftlint: disable=GL008\n"
+    new, n = fix(src)
+    assert n == 0 and new == src
+
+
+def test_fix_handles_multiline_parenthesized_import():
+    new, n = fix(
+        """
+        from os.path import (
+            join,
+            basename,
+        )
+
+        print(basename("x"))
+        """
+    )
+    assert n == 1
+    assert "from os.path import basename" in new and "join" not in new
+
+
+def test_fix_paths_rewrites_in_place(tmp_path):
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.fix import fix_paths
+
+    mod = tmp_path / "mod.py"
+    mod.write_text("import os\nimport sys\n\nprint(sys.argv)\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    files_changed, removed = fix_paths([str(tmp_path)])
+    assert (files_changed, removed) == (1, 1)
+    assert mod.read_text() == "import sys\n\nprint(sys.argv)\n"
+    assert clean.read_text() == "x = 1\n"
+
+
+def test_cli_fix_then_lints_clean(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    mod = tmp_path / "mod.py"
+    mod.write_text("import os\n\nx = 1\n")
+    assert cli_main([str(mod), "--fix", "--no-baseline"]) == 0
+    assert "import os" not in mod.read_text()
+
+
+# ----------------------------------------------- TA pragmas share the regex
+def test_suppression_regex_accepts_ta_rules():
+    """graftcheck findings anchor to register_entrypoint lines and reuse
+    graftlint's pragma machinery, so TA ids must parse."""
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.core import (
+        Finding,
+        Suppressions,
+    )
+
+    src = "register_entrypoint('x', f)  # graftlint: disable=TA003\n"
+    supp = Suppressions(src)
+    ta = Finding(
+        path="mod.py", line=1, col=1, rule="TA003", name="x", message="m"
+    )
+    gl = Finding(
+        path="mod.py", line=1, col=1, rule="GL001", name="x", message="m"
+    )
+    assert supp.is_suppressed(ta)
+    assert not supp.is_suppressed(gl)
 
 
 def test_repo_tree_is_lint_clean():

@@ -1,0 +1,498 @@
+"""graftcheck trace-audit tests.
+
+Three layers:
+
+1. **TA003 sweep** — every ``--sync`` strategy (CIFAR) and every LM data
+   -parallel mode is traced on the 8-virtual-device CPU harness and its
+   collective schedule + bytes-on-wire are checked against the contract
+   model in :mod:`parallel.sync` and the telemetry accounting in
+   :func:`parallel.sync.sync_wire_bytes`.
+2. **Seeded regressions** — hand-built step functions with an injected
+   f32 upcast, a dropped donation, a giant trace constant, and a dead
+   matmul must each be flagged by exactly the intended rule.
+3. **Contract tests** — registry, suppressions, CLI exit codes, and the
+   clean-repo gate (auditing the real registered entrypoints finds
+   nothing).
+
+Tracing uses ``jax.make_jaxpr`` only, so the sweep is cheap; only the
+donation tests compile (tiny shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace import (
+    TracedStep,
+    get_entrypoints,
+    load_builtin_entrypoints,
+    register_entrypoint,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.audits import (
+    TRACE_RULES,
+    audit_entry,
+    run_audits,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.cli import (
+    main as trace_cli_main,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace import jaxpr_utils
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+    _REGISTRY,
+)
+
+ALL_RULES = set(TRACE_RULES)
+TRACE_ONLY = ALL_RULES - {"TA002"}  # TA002 lowers+compiles; the rest trace
+
+
+@pytest.fixture(autouse=True)
+def _registry_guard():
+    """Tests register throwaway entrypoints; restore the registry after."""
+    before = dict(_REGISTRY)
+    yield
+    _REGISTRY.clear()
+    _REGISTRY.update(before)
+
+
+def entry_for(step: TracedStep, name: str):
+    register_entrypoint(name, lambda: step)
+    return get_entrypoints([name])[0]
+
+
+def audit(step: TracedStep, rules=TRACE_ONLY, name: str = "fixture"):
+    findings, _info = audit_entry(entry_for(step, name), set(rules))
+    return findings
+
+
+# =================================================== TA003 schedule sweep
+CIFAR_SYNCS = [
+    "allreduce",
+    "ring",
+    "int8_allreduce",
+    "zero1",
+    "fsdp",
+    "gather_scatter",
+    "p2p_star",
+    "auto",
+]
+
+
+@pytest.mark.parametrize("sync", CIFAR_SYNCS)
+def test_ta003_cifar_schedule_matches_contract(sync, devices):
+    from cs744_pytorch_distributed_tutorial_tpu.train.engine import (
+        make_trace_entry,
+    )
+
+    step = make_trace_entry(sync=sync)
+    closed = jax.make_jaxpr(step.fn)(*step.args)
+    colls = jaxpr_utils.collect_collectives(closed, step.axis_sizes)
+    counts = jaxpr_utils.schedule_counts(colls)
+    assert step.expected_schedule is not None
+    expected = {k: v for k, v in step.expected_schedule.items() if v}
+    assert counts == expected, f"{sync}: {counts} != {expected}"
+
+    wire = jaxpr_utils.total_wire_bytes(colls)
+    assert step.expected_wire_bytes is not None
+    tol = max(0.01 * step.expected_wire_bytes, 512.0)
+    assert abs(wire - step.expected_wire_bytes) <= tol, (
+        f"{sync}: jaxpr wire {wire} vs accounting "
+        f"{step.expected_wire_bytes}"
+    )
+
+
+def test_ta003_int8_wire_beats_f32(devices):
+    from cs744_pytorch_distributed_tutorial_tpu.train.engine import (
+        make_trace_entry,
+    )
+
+    def jaxpr_wire(sync):
+        step = make_trace_entry(sync=sync)
+        closed = jax.make_jaxpr(step.fn)(*step.args)
+        return jaxpr_utils.total_wire_bytes(
+            jaxpr_utils.collect_collectives(closed, step.axis_sizes)
+        )
+
+    f32 = jaxpr_wire("allreduce")
+    int8 = jaxpr_wire("int8_allreduce")
+    assert 0 < int8 < f32, (int8, f32)
+
+
+LM_MODES = {
+    "allreduce": {},
+    "int8": {"grad_compress": "int8"},
+    "zero1": {"zero1": True},
+    "fsdp": {"fsdp": True},
+}
+
+
+@pytest.mark.parametrize("mode", sorted(LM_MODES))
+def test_ta003_lm_schedule_matches_contract(mode, devices):
+    from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
+        make_lm_trace_entry,
+    )
+
+    step = make_lm_trace_entry(**LM_MODES[mode])
+    closed = jax.make_jaxpr(step.fn)(*step.args)
+    colls = jaxpr_utils.collect_collectives(closed, step.axis_sizes)
+    counts = jaxpr_utils.schedule_counts(colls)
+    assert step.expected_schedule is not None
+    expected = {k: v for k, v in step.expected_schedule.items() if v}
+    assert counts == expected, f"{mode}: {counts} != {expected}"
+
+    wire = jaxpr_utils.total_wire_bytes(colls)
+    tol = max(0.01 * step.expected_wire_bytes, 512.0)
+    assert abs(wire - step.expected_wire_bytes) <= tol, (
+        f"{mode}: jaxpr wire {wire} vs accounting "
+        f"{step.expected_wire_bytes}"
+    )
+
+
+def test_ta003_flags_schedule_mismatch(mesh4):
+    """A step whose contract promises ring but runs allreduce is caught."""
+
+    def psum_step(x):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(v, "data"),
+            mesh=mesh4,
+            in_specs=jax.sharding.PartitionSpec("data"),
+            out_specs=jax.sharding.PartitionSpec(),
+        )(x)
+
+    step = TracedStep(
+        name="mismatch",
+        fn=psum_step,
+        args=(jnp.zeros((4, 128), jnp.float32),),
+        axis_sizes={"data": 4},
+        expected_schedule={"ppermute": 6},
+        check_donation=False,
+    )
+    findings = audit(step, rules={"TA003"})
+    assert [f.rule for f in findings] == ["TA003"]
+    assert "ppermute" in findings[0].message
+
+
+# ================================================== seeded TA001 upcast
+def _bf16_block_with_f32_leak(leak: bool):
+    w1 = jnp.ones((16, 16), jnp.bfloat16)
+    w2 = jnp.ones((16, 16), jnp.bfloat16)
+
+    def step(x):
+        h = jnp.dot(x, w1)  # bf16 x bf16 -> bf16: fine
+        if leak:
+            # The forgotten-cast bug TA001 hunts: one block promotes to
+            # f32 and the matmul silently runs at 4 bytes/element.
+            h = jnp.dot(h.astype(jnp.float32), w2.astype(jnp.float32))
+        else:
+            h = jnp.dot(h, w2)
+        return h.astype(jnp.float32).sum()
+
+    return step, (jnp.ones((8, 16), jnp.bfloat16),)
+
+
+def test_ta001_flags_injected_f32_upcast():
+    fn, args = _bf16_block_with_f32_leak(leak=True)
+    step = TracedStep(
+        name="leak",
+        fn=fn,
+        args=args,
+        axis_sizes={},
+        compute_dtype="bfloat16",
+        check_donation=False,
+    )
+    findings = audit(step)
+    assert [f.rule for f in findings] == ["TA001"]
+    assert "f32 dot_general" in findings[0].message
+
+
+def test_ta001_clean_bf16_block():
+    fn, args = _bf16_block_with_f32_leak(leak=False)
+    step = TracedStep(
+        name="clean",
+        fn=fn,
+        args=args,
+        axis_sizes={},
+        compute_dtype="bfloat16",
+        check_donation=False,
+    )
+    assert audit(step) == []
+
+
+def test_ta001_allowlists_loss_and_optimizer_frames():
+    """f32 math inside loss/norm/optimizer code is the sanctioned
+    mixed-precision pattern, not a leak."""
+    w = jnp.ones((16, 16), jnp.bfloat16)
+
+    def cross_entropy_loss(h):
+        # f32 matmul, but the frame name matches the allowlist.
+        return jnp.dot(h.astype(jnp.float32), jnp.eye(16)).sum()
+
+    def step(x):
+        return cross_entropy_loss(jnp.dot(x, w))
+
+    step_t = TracedStep(
+        name="allow",
+        fn=step,
+        args=(jnp.ones((8, 16), jnp.bfloat16),),
+        axis_sizes={},
+        compute_dtype="bfloat16",
+        check_donation=False,
+    )
+    assert audit(step_t) == []
+
+
+# ================================================ seeded TA002 donation
+def test_ta002_flags_dropped_donation():
+    """Donating a buffer the output cannot alias (shape mismatch) is a
+    dropped donation — HBM holds both copies."""
+
+    def fn(x):
+        return x.sum()  # scalar out: the (8,8) donated input can't alias
+
+    step = TracedStep(
+        name="dropped",
+        fn=jax.jit(fn, donate_argnums=0),
+        args=(jnp.ones((8, 8), jnp.float32),),
+        axis_sizes={},
+    )
+    findings = audit(step, rules={"TA002"})
+    assert [f.rule for f in findings] == ["TA002"]
+    assert "donated" in findings[0].message
+
+
+def test_ta002_clean_honoured_donation():
+    def fn(x):
+        return x + 1.0
+
+    step = TracedStep(
+        name="honoured",
+        fn=jax.jit(fn, donate_argnums=0),
+        args=(jnp.ones((8, 8), jnp.float32),),
+        axis_sizes={},
+    )
+    assert audit(step, rules={"TA002"}) == []
+
+
+# =========================================== seeded TA004 trace constant
+def test_ta004_flags_large_closure_constant():
+    big = jnp.asarray(np.ones((512, 1024), np.float32))  # 2 MiB
+
+    def fn(x):
+        return (x @ big).sum()
+
+    step = TracedStep(
+        name="const",
+        fn=fn,
+        args=(jnp.ones((4, 512), jnp.float32),),
+        axis_sizes={},
+        check_donation=False,
+    )
+    findings = audit(step)
+    assert [f.rule for f in findings] == ["TA004"]
+    assert "2.0 MiB" in findings[0].message
+
+
+def test_ta004_small_literals_are_fine():
+    scale = jnp.float32(2.0)
+
+    def fn(x):
+        return (x * scale).sum()
+
+    step = TracedStep(
+        name="small",
+        fn=fn,
+        args=(jnp.ones((4, 4), jnp.float32),),
+        axis_sizes={},
+        check_donation=False,
+    )
+    assert audit(step) == []
+
+
+# ============================================== seeded TA005 dead matmul
+def test_ta005_flags_dead_matmul():
+    def fn(x, w):
+        dead = x @ w  # computed, never used
+        del dead
+        return x.sum()
+
+    step = TracedStep(
+        name="dead",
+        fn=fn,
+        args=(
+            jnp.ones((32, 32), jnp.float32),
+            jnp.ones((32, 32), jnp.float32),
+        ),
+        axis_sizes={},
+        check_donation=False,
+    )
+    findings = audit(step)
+    assert [f.rule for f in findings] == ["TA005"]
+    assert "dot_general" in findings[0].message
+
+
+def test_ta005_live_matmul_is_fine():
+    def fn(x, w):
+        return (x @ w).sum()
+
+    step = TracedStep(
+        name="live",
+        fn=fn,
+        args=(
+            jnp.ones((32, 32), jnp.float32),
+            jnp.ones((32, 32), jnp.float32),
+        ),
+        axis_sizes={},
+        check_donation=False,
+    )
+    assert audit(step) == []
+
+
+# ====================================================== registry contract
+def test_registry_records_registration_site():
+    def factory():
+        raise AssertionError("not built by --list-entrypoints")
+
+    register_entrypoint("site-probe", factory, tags=("test",))
+    (entry,) = get_entrypoints(["site-probe"])
+    assert entry.path.endswith("test_trace_audit.py")
+    assert entry.line > 0
+    assert entry.tags == ("test",)
+
+
+def test_registry_unknown_name_lists_known():
+    register_entrypoint("known-one", lambda: None)
+    with pytest.raises(KeyError) as exc:
+        get_entrypoints(["nope"])
+    assert "known-one" in exc.value.args[0]
+
+
+def test_builtin_entrypoints_load():
+    load_builtin_entrypoints()
+    names = {e.name for e in get_entrypoints()}
+    assert {"cifar", "cifar-int8", "lm"} <= names
+
+
+def test_clean_repo_audits_green(devices):
+    """The acceptance gate: every registered entrypoint audits clean."""
+    load_builtin_entrypoints()
+    entries = get_entrypoints(["cifar", "cifar-int8", "lm"])
+    findings, _suppressed, summaries, _sources, errors = run_audits(
+        entries, ALL_RULES
+    )
+    assert errors == []
+    assert findings == []
+    assert len(summaries) == 3
+    for s in summaries:
+        assert s["donation"]["donated"] == s["donation"]["aliased"]
+
+
+# ========================================================== suppressions
+def test_ta_suppression_pragma_at_registration_site(tmp_path):
+    """``# graftlint: disable=TA001`` on the register_entrypoint line
+    silences that rule for that entrypoint, exactly like GL pragmas."""
+    mod = tmp_path / "seeded_entry.py"
+    mod.write_text(
+        textwrap.dedent(
+            """
+            import jax.numpy as jnp
+            from cs744_pytorch_distributed_tutorial_tpu.analysis.trace import (
+                TracedStep,
+                register_entrypoint,
+            )
+
+            w = jnp.ones((16, 16), jnp.bfloat16)
+
+            def _fn(x):
+                h = jnp.dot(x, w)
+                return jnp.dot(
+                    h.astype(jnp.float32), jnp.eye(16, dtype=jnp.float32)
+                ).sum()
+
+            def _factory():
+                return TracedStep(
+                    name="seeded",
+                    fn=_fn,
+                    args=(jnp.ones((8, 16), jnp.bfloat16),),
+                    axis_sizes={},
+                    compute_dtype="bfloat16",
+                    check_donation=False,
+                )
+
+            register_entrypoint("seeded-suppressed", _factory)  # graftlint: disable=TA001
+            register_entrypoint("seeded-loud", _factory)
+            """
+        )
+    )
+    code = compile(mod.read_text(), str(mod), "exec")
+    exec(code, {"__name__": "seeded_entry", "__file__": str(mod)})
+
+    entries = get_entrypoints(["seeded-suppressed", "seeded-loud"])
+    findings, suppressed, _summaries, _sources, errors = run_audits(
+        entries, {"TA001"}
+    )
+    assert errors == []
+    assert suppressed == 1
+    assert len(findings) == 1
+    assert "[seeded-loud]" in findings[0].message
+
+
+# ================================================================== CLI
+def test_cli_list_rules(capsys):
+    assert trace_cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in TRACE_RULES:
+        assert rid in out
+
+
+def test_cli_list_entrypoints(capsys):
+    assert trace_cli_main(["--list-entrypoints"]) == 0
+    out = capsys.readouterr().out
+    assert "cifar" in out and "lm" in out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert trace_cli_main(["--select", "TA999"]) == 2
+
+
+def test_cli_unknown_entry_is_usage_error(capsys):
+    assert trace_cli_main(["no-such-entry"]) == 2
+
+
+def test_cli_json_report_roundtrip(tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)  # keep any baseline writes out of the repo
+    report = tmp_path / "audit_report.json"
+    rc = trace_cli_main(
+        [
+            "cifar",
+            "--select",
+            "TA003,TA004,TA005",
+            "--format",
+            "json",
+            "--report",
+            str(report),
+        ]
+    )
+    assert rc == 0
+    stdout_payload = json.loads(capsys.readouterr().out)
+    disk_payload = json.loads(report.read_text())
+    assert stdout_payload == disk_payload
+    assert disk_payload["exit_code"] == 0
+    assert disk_payload["errors"] == []
+    (summary,) = disk_payload["entries"]
+    assert summary["entry"] == "cifar"
+    assert summary["schedule"] == {"psum": 1}
+
+
+def test_cli_dispatch_from_analysis_main(capsys):
+    """``python -m ...analysis trace`` routes to graftcheck."""
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.cli import (
+        main as analysis_main,
+    )
+
+    assert analysis_main(["trace", "--list-rules"]) == 0
+    assert "TA001" in capsys.readouterr().out
