@@ -171,6 +171,81 @@ def sync_compare(
         )
 
 
+def phase_breakdown(
+    sink,
+    batch: int = GLOBAL_BATCH,
+    *,
+    model: str = "resnet18",
+    sync: str = "auto",
+    grad_compress: str = "none",
+    compute_dtype: str = "bfloat16",
+    iters: int = 3,
+    metrics_dir: str | None = None,
+) -> bool:
+    """graftscope mode (obs/phases.py): compile forward / backward /
+    grad-sync / optimizer as separate fenced segments, parity-check the
+    segmented step against the fused fast path, and emit per-phase
+    device time, flops, bytes, MFU, roofline class, and
+    ``sync_exposed_ms`` — the optimization target for the sync-overlap
+    work (ROADMAP item 2). Returns parity_ok (the caller exits nonzero
+    on False: attribution of a step that computes something else is
+    not a benchmark)."""
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        profile_phases,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    n_chips = len(jax.devices())
+    cfg = TrainConfig(
+        model=model,
+        sync=sync,
+        grad_compress=grad_compress,
+        num_devices=n_chips,
+        global_batch_size=batch,
+        compute_dtype=compute_dtype,
+        synthetic_data=True,
+    )
+    mesh = make_mesh({"data": n_chips})
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init()
+    ds = synthetic_cifar10(batch, 16, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    key = jax.random.key(cfg.seed)
+    report = profile_phases(trainer, state, x, y, key, iters=iters)
+    now = time.time()
+    for rec in report.records(run=f"bench_{model}"):
+        sink.emit({**rec, "time": now})
+    sink.emit(
+        {
+            "kind": "bench",
+            "time": now,
+            "metric": f"cifar10_{model}_phase_breakdown",
+            # Throughput derived from the fused-step time so regress.py
+            # can gate this mode with the same tolerance arithmetic as
+            # the headline metric.
+            "value": round(batch / (report.fused_ms / 1e3) / n_chips, 1),
+            "unit": "samples/sec/chip",
+            "batch": batch,
+            "sync_exposed_ms": round(report.sync_exposed_ms, 4),
+            "parity_ok": report.parity_ok,
+        }
+    )
+    print(report.table(), file=sys.stderr)
+    if metrics_dir:
+        import json
+        import os
+
+        with open(os.path.join(metrics_dir, "phase_report.json"), "w") as f:
+            json.dump(report.records(run=f"bench_{model}"), f, indent=1)
+    return report.parity_ok
+
+
 def _parse_args() -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
@@ -179,6 +254,38 @@ def _parse_args() -> argparse.Namespace:
         help="report samples/sec/chip and gradient bytes-on-wire per "
         "step for f32 per-leaf / f32 bucketed / int8 bucketed sync "
         "instead of the headline benchmark",
+    )
+    p.add_argument(
+        "--phase-breakdown",
+        action="store_true",
+        help="graftscope mode: per-phase (forward/backward/grad-sync/"
+        "optimizer) device time, flops, bytes, MFU, roofline class, and "
+        "sync_exposed_ms, with segmented-vs-fused parity checking",
+    )
+    p.add_argument(
+        "--batch", type=int, default=GLOBAL_BATCH,
+        help="global batch size for --phase-breakdown (default %(default)s)",
+    )
+    p.add_argument(
+        "--model", default="resnet18",
+        help="model for --phase-breakdown (default %(default)s)",
+    )
+    p.add_argument(
+        "--sync", default="auto",
+        help="sync strategy for --phase-breakdown (default %(default)s)",
+    )
+    p.add_argument(
+        "--grad-compress", default="none", choices=("none", "int8"),
+        help="gradient compression for --phase-breakdown",
+    )
+    p.add_argument(
+        "--compute-dtype", default="bfloat16",
+        help="compute dtype for --phase-breakdown (default %(default)s; "
+        "float32 keeps the parity check at the strict f32 tolerance)",
+    )
+    p.add_argument(
+        "--phase-iters", type=int, default=3,
+        help="timed iterations per segment for --phase-breakdown",
     )
     p.add_argument(
         "--metrics-dir",
@@ -193,6 +300,20 @@ def main() -> None:
     args = _parse_args()
     sink = _make_sink(args.metrics_dir)
     try:
+        if args.phase_breakdown:
+            ok = phase_breakdown(
+                sink,
+                args.batch,
+                model=args.model,
+                sync=args.sync,
+                grad_compress=args.grad_compress,
+                compute_dtype=args.compute_dtype,
+                iters=args.phase_iters,
+                metrics_dir=args.metrics_dir,
+            )
+            if not ok:
+                sys.exit(1)
+            return
         if args.sync_compare:
             sync_compare(sink)
             return

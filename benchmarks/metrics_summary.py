@@ -50,6 +50,27 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
     wire = [r["grad_sync_bytes"] for r in steps
             if isinstance(r.get("grad_sync_bytes"), (int, float))]
     events = [r for r in records if r.get("kind") == "event"]
+    # graftscope per-phase records (bench.py --phase-breakdown): one row
+    # per phase, keyed by name, latest record wins on repeat runs.
+    phases: dict[str, dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") == "phase" and isinstance(r.get("phase"), str):
+            row = {
+                k: r.get(k)
+                for k in ("clock", "flops", "bytes_accessed",
+                          "comm_bytes", "mfu", "roofline")
+            }
+            row["ms"] = (
+                r.get("device_ms")
+                if r.get("clock") == "device"
+                else r.get("wall_ms")
+            )
+            phases[r["phase"]] = row
+    sync_exposed = [
+        float(r["sync_exposed_ms"]) for r in records
+        if r.get("kind") == "phase_summary"
+        and isinstance(r.get("sync_exposed_ms"), (int, float))
+    ]
     return {
         "records": len(records),
         "step_records": len(steps),
@@ -62,6 +83,8 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "mean_mfu": _mean(mfus),
         "total_grad_sync_bytes": sum(wire) if wire else None,
         "events": sorted({e.get("event") for e in events}),
+        "phases": phases,
+        "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
     }
 
 
@@ -94,6 +117,15 @@ def main(argv: list[str] | None = None) -> int:
         ("grad sync bytes (total)", summary["total_grad_sync_bytes"]),
         ("events", ", ".join(summary["events"]) or None),
     ]
+    for name, row in summary["phases"].items():
+        rows.append((
+            f"phase {name}",
+            f"{_fmt(row['ms'])} ms ({_fmt(row['clock'])}), "
+            f"{_fmt(row['flops'])} flops, {_fmt(row['comm_bytes'])} comm B, "
+            f"{_fmt(row['roofline'])}",
+        ))
+    if summary["sync_exposed_ms"] is not None:
+        rows.append(("sync exposed (ms)", summary["sync_exposed_ms"]))
     width = max(len(name) for name, _ in rows)
     for name, val in rows:
         print(f"{name:<{width}}  {_fmt(val)}")

@@ -21,6 +21,21 @@ T = TypeVar("T")
 _STOP = object()
 
 
+def _trace_annotation(name: str):
+    """Profiler annotation for the producer thread, so Perfetto captures
+    show host batch assembly as labeled spans on the prefetch lane (the
+    graftscope label map, docs/observability.md). Null context when jax
+    is absent — this module must stay importable on jax-less hosts."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover — jax-less host tooling
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
 class PrefetchIterator(Iterator[T]):
     """Wrap any iterator; a daemon thread keeps ``depth`` items ready.
 
@@ -52,9 +67,9 @@ class PrefetchIterator(Iterator[T]):
     def _produce(self, it: Iterator[T]) -> None:
         try:
             prev = None
-            for item in it:
+            while True:
                 # Materialize the PREVIOUS item on the producer thread
-                # before offering the next: the consumer never absorbs
+                # before pulling the next: the consumer never absorbs
                 # deferred device_put work inside its own dispatch
                 # chain, while THIS item's transfer still overlaps the
                 # next batch's host assembly (blocking on the fresh item
@@ -64,7 +79,12 @@ class PrefetchIterator(Iterator[T]):
                 # successor's production has fenced it. Transfer errors
                 # surface here and relay to the consumer like any other
                 # producer exception.
-                self._block_ready(prev)
+                with _trace_annotation("graftscope/prefetch_produce"):
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    self._block_ready(prev)
                 prev = item
                 if not self._offer(item):
                     return

@@ -14,6 +14,16 @@ from .metrics import (
     tree_l2_norm,
     tree_sq_norm,
 )
+from .flight import FlightRecorder, HbmHighWater, StragglerMonitor
+from .phases import (
+    PhaseReport,
+    PhaseStat,
+    capture_device_profile,
+    phase_records_from_stream,
+    profile_lm_phases,
+    profile_phases,
+    render_phase_table,
+)
 from .run_manifest import build_manifest, read_manifest, write_manifest
 from .sinks import (
     CsvSink,
@@ -36,6 +46,16 @@ __all__ = [
     "speculative_accept_rate",
     "tree_l2_norm",
     "tree_sq_norm",
+    "FlightRecorder",
+    "HbmHighWater",
+    "StragglerMonitor",
+    "PhaseReport",
+    "PhaseStat",
+    "capture_device_profile",
+    "phase_records_from_stream",
+    "profile_lm_phases",
+    "profile_phases",
+    "render_phase_table",
     "build_manifest",
     "read_manifest",
     "write_manifest",

@@ -1,0 +1,81 @@
+"""Render telemetry artifacts from the command line.
+
+    python -m cs744_pytorch_distributed_tutorial_tpu.obs report <metrics_dir>
+
+``report`` reads a metrics dir (or a metrics.jsonl / phase_report.json
+directly), filters the graftscope ``kind="phase"``/``"phase_summary"``
+records, and prints the per-phase attribution table — same renderer
+``bench.py --phase-breakdown`` prints live, usable after the fact on
+any machine the JSONL landed on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .metrics import METRICS_NAME
+from .phases import phase_records_from_stream, render_phase_table
+
+
+def _load_stream(path: str) -> list[dict]:
+    """metrics dir, JSONL stream, or a phase_report.json array."""
+    if os.path.isdir(path):
+        for name in (METRICS_NAME, "phase_report.json"):
+            candidate = os.path.join(path, name)
+            if os.path.exists(candidate):
+                path = candidate
+                break
+        else:
+            raise FileNotFoundError(
+                f"{path}: no {METRICS_NAME} or phase_report.json"
+            )
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, list):
+            return [r for r in obj if isinstance(r, dict)]
+        if isinstance(obj, dict):
+            return [obj]
+    except json.JSONDecodeError:
+        pass
+    records = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return records
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m cs744_pytorch_distributed_tutorial_tpu.obs",
+        description=__doc__,
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="render phase records as a table")
+    rep.add_argument(
+        "path", help="metrics dir, metrics.jsonl, or phase_report.json"
+    )
+    args = p.parse_args(argv)
+
+    records = phase_records_from_stream(_load_stream(args.path))
+    if not records:
+        print("no phase records found (run bench.py --phase-breakdown "
+              "with --metrics-dir first)", file=sys.stderr)
+        return 1
+    print(render_phase_table(records))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

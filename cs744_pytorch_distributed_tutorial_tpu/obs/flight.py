@@ -1,0 +1,270 @@
+"""graftscope flight recorder: straggler detection + crash-time dumps.
+
+A 30-minute run that dies, hangs, or slows down leaves nothing behind
+unless someone was watching a dashboard. This module keeps a bounded
+in-memory tail of per-step timing — cheap enough to run always-on —
+and dumps it as structured ``kind="event"`` telemetry records when
+something goes wrong:
+
+- **StragglerMonitor**: MAD-based outlier detection over a ring of
+  per-step wall times. The median/MAD pair is robust to the outliers
+  it hunts (a mean/stddev detector would let one 10x step inflate its
+  own threshold); the sigma floor keeps sub-millisecond CPU steps from
+  flagging scheduler noise.
+- **HbmHighWater**: per-device ``peak_bytes_in_use`` deltas — a step
+  that suddenly allocates (retrace, fragmentation) shows up here even
+  when its wall time doesn't.
+- **FlightRecorder**: binds the above to a Telemetry instance and dumps
+  the tail on demand. ``install()`` chains SIGTERM and
+  ``sys.excepthook`` so preemptions and crashes self-report; the
+  StepWatchdog (``utils/failure.py``) calls ``dump("watchdog")`` when a
+  step wedges.
+
+Everything here is host-side bookkeeping around ``time`` values already
+on the host — nothing touches a traced scope (GL001/GL007-clean by
+construction).
+"""
+
+from __future__ import annotations
+
+import signal
+import statistics
+import sys
+import threading
+from collections import deque
+from typing import Any, Callable
+
+__all__ = ["StragglerMonitor", "HbmHighWater", "FlightRecorder"]
+
+# 1 MAD of a normal distribution = 1/1.4826 sigma.
+_MAD_TO_SIGMA = 1.4826
+
+
+class StragglerMonitor:
+    """Per-step wall-time ring with MAD outlier detection.
+
+    ``record(step, wall_s)`` judges the new step against the PRIOR
+    window (so an outlier cannot vote on its own threshold), then
+    appends it. Returns an outlier dict or None. Thread-compatible with
+    the engines' single-threaded step loops; not locked.
+    """
+
+    def __init__(
+        self,
+        window: int = 512,
+        mad_k: float = 5.0,
+        min_samples: int = 16,
+        floor_s: float = 1e-4,
+        max_outliers: int = 32,
+    ):
+        if window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        self.window = window
+        self.mad_k = float(mad_k)
+        self.min_samples = int(min_samples)
+        self.floor_s = float(floor_s)
+        self._ring: deque[tuple[int, float]] = deque(maxlen=window)
+        self.outliers: deque[dict[str, Any]] = deque(maxlen=max_outliers)
+        self.steps_recorded = 0
+        self._max_s = 0.0
+
+    def _median_mad(self) -> tuple[float, float]:
+        vals = [w for _, w in self._ring]
+        med = statistics.median(vals)
+        mad = statistics.median(abs(v - med) for v in vals)
+        return med, mad
+
+    def record(self, step: int, wall_s: float) -> dict[str, Any] | None:
+        """Record one step; return an outlier record if this step is a
+        straggler relative to the window BEFORE it."""
+        wall_s = float(wall_s)
+        out = None
+        if len(self._ring) >= self.min_samples:
+            med, mad = self._median_mad()
+            # Floored sigma: MAD=0 (perfectly uniform window) must not
+            # make every jitter an outlier, and a 5%-of-median floor
+            # absorbs ordinary scheduler noise on fast steps.
+            sigma = max(_MAD_TO_SIGMA * mad, 0.05 * med, self.floor_s)
+            if wall_s > med + self.mad_k * sigma:
+                out = {
+                    "step": int(step),
+                    "wall_s": wall_s,
+                    "median_s": med,
+                    "mad_s": mad,
+                    "excess_sigma": (wall_s - med) / sigma,
+                }
+                self.outliers.append(out)
+        self._ring.append((int(step), wall_s))
+        self.steps_recorded += 1
+        self._max_s = max(self._max_s, wall_s)
+        return out
+
+    def stats(self) -> dict[str, Any]:
+        s: dict[str, Any] = {
+            "steps_recorded": self.steps_recorded,
+            "window": len(self._ring),
+            "outlier_count": len(self.outliers),
+            "max_s": self._max_s,
+        }
+        if len(self._ring) >= 2:
+            med, mad = self._median_mad()
+            s["median_s"] = med
+            s["mad_s"] = mad
+        return s
+
+    def tail(self, n: int = 32) -> list[dict[str, Any]]:
+        return [
+            {"step": step, "wall_s": wall_s}
+            for step, wall_s in list(self._ring)[-n:]
+        ]
+
+
+class HbmHighWater:
+    """Per-device HBM high-water tracking via ``memory_stats()``.
+
+    ``snapshot()`` re-reads each device and returns the devices whose
+    ``peak_bytes_in_use`` ROSE since the last snapshot (delta records).
+    Devices without memory stats (CPU) contribute nothing.
+    """
+
+    def __init__(self, devices: Any = None):
+        from .system import hbm_stats
+
+        self._hbm_stats = hbm_stats
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+        self.devices = list(devices)
+        self._peaks: dict[int, int] = {}
+        self.snapshot()  # establish the baseline
+
+    def snapshot(self) -> list[dict[str, Any]]:
+        deltas = []
+        for i, d in enumerate(self.devices):
+            stats = self._hbm_stats(d)
+            if not stats or "peak_bytes_in_use" not in stats:
+                continue
+            peak = int(stats["peak_bytes_in_use"])
+            prev = self._peaks.get(i)
+            if prev is not None and peak > prev:
+                deltas.append(
+                    {
+                        "device": i,
+                        "peak_bytes_in_use": peak,
+                        "delta_bytes": peak - prev,
+                        "bytes_in_use": stats.get("bytes_in_use"),
+                    }
+                )
+            self._peaks[i] = peak
+        return deltas
+
+    def highwater(self) -> dict[str, int]:
+        return {f"hbm_peak_dev{i}": p for i, p in sorted(self._peaks.items())}
+
+
+class FlightRecorder:
+    """Dumps the straggler/timing tail as structured telemetry events.
+
+    One ``flight_dump`` header event (reason, straggler stats, HBM
+    high-water), then one ``flight_step`` event per tail step and one
+    ``flight_straggler`` event per recorded outlier — flat records so
+    every sink (JSONL, stream, ring) can carry them and
+    ``metrics_summary`` can count them. Dump triggers: watchdog fire
+    (wired in ``utils/failure.py``), uncaught exception + SIGTERM (via
+    ``install()``), or an explicit call.
+    """
+
+    def __init__(
+        self,
+        telemetry: Any = None,
+        straggler: StragglerMonitor | None = None,
+        hbm: HbmHighWater | None = None,
+        ring_tail: int = 32,
+        emit: Callable[..., None] | None = None,
+    ):
+        if telemetry is None and emit is None:
+            raise ValueError("FlightRecorder needs a telemetry or an emit fn")
+        self._emit = emit if emit is not None else telemetry.emit_event
+        self.straggler = straggler
+        self.hbm = hbm
+        self.ring_tail = int(ring_tail)
+        self.dumps = 0
+        self._lock = threading.Lock()
+        self._prev_sigterm: Any = None
+        self._prev_excepthook: Any = None
+        self._installed = False
+
+    def dump(self, reason: str, **extra: Any) -> None:
+        """Emit the flight tail. Never raises: this runs on the way down
+        (crash, preemption, hang) and must not mask the original error."""
+        with self._lock:
+            self.dumps += 1
+            try:
+                header: dict[str, Any] = {"reason": reason, **extra}
+                if self.straggler is not None:
+                    for k, v in self.straggler.stats().items():
+                        header[f"straggler_{k}"] = v
+                if self.hbm is not None:
+                    self.hbm.snapshot()
+                    header.update(self.hbm.highwater())
+                self._emit("flight_dump", **header)
+                if self.straggler is not None:
+                    for rec in self.straggler.tail(self.ring_tail):
+                        self._emit("flight_step", **rec)
+                    for out in list(self.straggler.outliers):
+                        self._emit("flight_straggler", **out)
+            except Exception:
+                pass
+
+    # -- process-level triggers ------------------------------------------
+
+    def install(self, sigterm: bool = True, excepthook: bool = True) -> None:
+        """Chain SIGTERM + uncaught-exception dumps. Previous handlers
+        still run (preemption semantics are preserved: after dumping, a
+        default-action SIGTERM is re-raised so the process still dies)."""
+        if self._installed:
+            return
+        if excepthook:
+            prev_hook = sys.excepthook
+            self._prev_excepthook = prev_hook
+
+            def hook(exc_type, exc, tb):
+                self.dump("exception", error=repr(exc))
+                prev_hook(exc_type, exc, tb)
+
+            sys.excepthook = hook
+        if sigterm:
+            try:
+                prev = signal.signal(signal.SIGTERM, self._on_sigterm)
+                self._prev_sigterm = prev
+            except ValueError:
+                # Not the main thread — signal handlers can't be set
+                # here; excepthook/watchdog triggers still work.
+                self._prev_sigterm = None
+        self._installed = True
+
+    def _on_sigterm(self, signum, frame):
+        self.dump("sigterm")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # Honor the default action: die of SIGTERM with the handler
+            # out of the way so the re-raise isn't caught again.
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            signal.raise_signal(signal.SIGTERM)
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+        self._installed = False

@@ -20,6 +20,7 @@ from __future__ import annotations
 __all__ = [
     "V5E_PEAK_FLOPS",
     "peak_flops_per_chip",
+    "peak_hbm_bytes_per_sec",
     "resnet18_cifar_train_flops_per_sample",
     "transformer_train_flops_per_token",
     "mfu",
@@ -41,12 +42,36 @@ _PEAKS: tuple[tuple[str, float], ...] = (
 )
 
 
+# Peak HBM bandwidth (bytes/sec) per chip, same matching discipline.
+# Pairs with _PEAKS to give each chip's roofline ridge point
+# (peak_flops / peak_hbm_bw) for graftscope's phase classification.
+_HBM_PEAKS: tuple[tuple[str, float], ...] = (
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v5p", 2765e9),
+    ("v4", 1228e9),
+    ("v6 lite", 1638e9),
+    ("v6e", 1638e9),
+)
+
+
 def peak_flops_per_chip(device_kind: str) -> float | None:
     """Peak dense bf16 FLOPs/sec for a jax ``device_kind`` string, or
     None when the kind is unknown (CPU, GPU, future TPUs) — callers
     must then report MFU as null rather than guess."""
     kind = device_kind.lower()
     for sub, peak in _PEAKS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def peak_hbm_bytes_per_sec(device_kind: str) -> float | None:
+    """Peak HBM bandwidth (bytes/sec) for a jax ``device_kind``, or
+    None when unknown — roofline classifiers then fall back to a
+    documented default ridge instead of a fabricated one."""
+    kind = device_kind.lower()
+    for sub, peak in _HBM_PEAKS:
         if sub in kind:
             return peak
     return None

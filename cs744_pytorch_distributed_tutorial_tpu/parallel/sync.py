@@ -313,19 +313,24 @@ def sync_grads(
     communication SHAPE — star hops, gather trees — is the point).
     """
     fn = get_sync(name)
+    # named_scope: pure HLO metadata (zero jaxpr eqns, graftcheck-TA003
+    # invisible) that labels the collective rows in Perfetto captures —
+    # graftscope's phase attribution relies on these names.
     if bucket_bytes and name in _BUCKETED and axis_size > 1:
-        rows = axis_size if name == "ring" else 0
-        layout = B.bucket_layout(grads, bucket_bytes, rows=rows)
-        bufs = B.flatten_for_sync(grads, layout)
-        if name == "ring":
-            synced = [
-                C.ring_all_reduce_rows(buf, axis_name, axis_size) / axis_size
-                for buf in bufs
-            ]
-        else:
-            synced = [C.all_reduce_mean(buf, axis_name) for buf in bufs]
-        return B.unflatten(synced, layout)
-    return C.tree_map_sync(lambda g: fn(g, axis_name, axis_size), grads)
+        with jax.named_scope(f"graftscope/sync/{name}/bucketed"):
+            rows = axis_size if name == "ring" else 0
+            layout = B.bucket_layout(grads, bucket_bytes, rows=rows)
+            bufs = B.flatten_for_sync(grads, layout)
+            if name == "ring":
+                synced = [
+                    C.ring_all_reduce_rows(buf, axis_name, axis_size) / axis_size
+                    for buf in bufs
+                ]
+            else:
+                synced = [C.all_reduce_mean(buf, axis_name) for buf in bufs]
+            return B.unflatten(synced, layout)
+    with jax.named_scope(f"graftscope/sync/{name}"):
+        return C.tree_map_sync(lambda g: fn(g, axis_name, axis_size), grads)
 
 
 def sync_grads_compressed(
@@ -359,17 +364,19 @@ def sync_grads_compressed(
     flat_fn = (
         _int8_ring_flat if name in ("ring", "int8_ring") else _int8_allreduce_flat
     )
-    layout = B.bucket_layout(grads, bucket_bytes or B.DEFAULT_BUCKET_BYTES, rows=0)
-    g_bufs = B.flatten_for_sync(grads, layout)
-    e_bufs = B.flatten_for_sync(ef, layout)
-    means, residuals = [], []
-    for g, e in zip(g_bufs, e_bufs):
-        dtype = g.dtype
-        b = g.astype(jnp.float32) + e.astype(jnp.float32)
-        mean, resid = flat_fn(b, axis_name, axis_size, quant_chunk)
-        means.append(mean.astype(dtype))
-        residuals.append(resid)
-    return B.unflatten(means, layout), B.unflatten(residuals, layout)
+    wire = "int8_ring" if name in ("ring", "int8_ring") else "int8_allreduce"
+    with jax.named_scope(f"graftscope/sync/{wire}"):
+        layout = B.bucket_layout(grads, bucket_bytes or B.DEFAULT_BUCKET_BYTES, rows=0)
+        g_bufs = B.flatten_for_sync(grads, layout)
+        e_bufs = B.flatten_for_sync(ef, layout)
+        means, residuals = [], []
+        for g, e in zip(g_bufs, e_bufs):
+            dtype = g.dtype
+            b = g.astype(jnp.float32) + e.astype(jnp.float32)
+            mean, resid = flat_fn(b, axis_name, axis_size, quant_chunk)
+            means.append(mean.astype(dtype))
+            residuals.append(resid)
+        return B.unflatten(means, layout), B.unflatten(residuals, layout)
 
 
 def sync_wire_bytes(

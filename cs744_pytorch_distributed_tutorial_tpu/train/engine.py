@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import time
 from typing import Any
 
 import jax
@@ -455,19 +456,24 @@ class Trainer:
             # seed-discipline analog, master/part2a/part2a.py:89-90).
             key = jax.random.fold_in(base_key, state.step)
             key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
-            x = (
-                augment_train_batch(key, images)
-                if cfg.augment
-                else eval_batch(images)
-            )
+            # graftscope named_scopes: pure HLO metadata that labels the
+            # fused step's regions in Perfetto captures (no jaxpr eqns —
+            # graftlint/graftcheck see nothing).
+            with jax.named_scope("graftscope/input_augment"):
+                x = (
+                    augment_train_batch(key, images)
+                    if cfg.augment
+                    else eval_batch(images)
+                )
             drop_key = jax.random.fold_in(key, 7)
 
             local_stats = jax.tree.map(lambda a: a[0], state.batch_stats)
 
             if accum == 1:
-                loss, local_loss, grads, new_stats = microbatch_grads(
-                    state.params, local_stats, x, labels, drop_key
-                )
+                with jax.named_scope("graftscope/fwd_bwd"):
+                    loss, local_loss, grads, new_stats = microbatch_grads(
+                        state.params, local_stats, x, labels, drop_key
+                    )
             else:
                 # Gradient accumulation: scan over microbatches — only ONE
                 # microbatch's activations are live at a time; grad sums
@@ -532,10 +538,16 @@ class Trainer:
                 # update and returns replicated params + the local
                 # momentum chunk. Under fsdp grads are the already-
                 # scattered [1, chunk] sums and the update stays chunk-wise.
-                new_params, new_opt = tx.apply(state.params, state.opt_state, grads)
+                with jax.named_scope("graftscope/optimizer"):
+                    new_params, new_opt = tx.apply(
+                        state.params, state.opt_state, grads
+                    )
             else:
-                updates, new_opt = tx.update(grads, state.opt_state, state.params)
-                new_params = optax.apply_updates(state.params, updates)
+                with jax.named_scope("graftscope/optimizer"):
+                    updates, new_opt = tx.update(
+                        grads, state.opt_state, state.params
+                    )
+                    new_params = optax.apply_updates(state.params, updates)
             if self.sync_monitor is not None:
                 from cs744_pytorch_distributed_tutorial_tpu.utils.debug import (
                     tree_checksum,
@@ -592,6 +604,10 @@ class Trainer:
             out_specs=(state_specs, metric_specs),
             check_vma=self._check_vma,
         )
+        # Un-jitted, un-donated handle for instrumentation (graftscope's
+        # parity/timing path re-jits WITHOUT donation so repeated calls
+        # on the same state don't hit deleted buffers).
+        self.mapped_train = mapped_train
         self.train_step = jax.jit(mapped_train, donate_argnums=0)
 
         def local_train_scan(state: TrainState, images, labels, base_key):
@@ -793,6 +809,21 @@ class Trainer:
             config=cfg, mesh=self.mesh, grad_sync_bytes_per_step=wire_bytes
         )
 
+        # ---- flight recorder (obs/flight.py): always-on per-step wall
+        # ring + MAD straggler detection; its tail dumps as structured
+        # events on watchdog fire, uncaught exception, or SIGTERM.
+        from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+            FlightRecorder,
+            HbmHighWater,
+            StragglerMonitor,
+        )
+
+        straggler = StragglerMonitor()
+        flight = FlightRecorder(
+            telemetry=telemetry, straggler=straggler, hbm=HbmHighWater()
+        )
+        flight.install()
+
         history: dict[str, Any] = {"train_loss": [], "eval": [], "avg_batch_time": None}
         timer = StepTimer(window=cfg.timing_batches)
         ckpt = None
@@ -833,11 +864,14 @@ class Trainer:
                 def on_hang(elapsed_s: float) -> None:
                     os._exit(13)
 
-            # The watchdog gets the telemetry ring: on firing it flushes
-            # the last step records so the post-mortem shows WHAT the run
-            # was doing, not just where the host is blocked.
+            # The watchdog gets the telemetry ring (WHAT the run was
+            # converging toward) and the flight recorder (what the STEP
+            # TIMES were doing): both flush on firing.
             watchdog = StepWatchdog(
-                cfg.step_timeout_s, on_hang=on_hang, metric_ring=telemetry.ring
+                cfg.step_timeout_s,
+                on_hang=on_hang,
+                metric_ring=telemetry.ring,
+                flight_recorder=flight,
             )
         if cfg.halt_on_nonfinite:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
@@ -893,6 +927,7 @@ class Trainer:
             jax.profiler.stop_trace()
             profiling_active = False
 
+        prev_mono = None  # per-step wall clock for the straggler ring
         try:
             for epoch in range(
                 start_epoch, epochs if epochs is not None else cfg.epochs
@@ -912,8 +947,14 @@ class Trainer:
                     arm_now = watchdog is not None and not compile_pending
                     if arm_now:
                         watchdog.arm()
+                    fetch_ctx = (
+                        profiling.annotate("graftscope/input_fetch")
+                        if profiling_active
+                        else contextlib.nullcontext()
+                    )
                     try:
-                        batch_idx, (x, y) = next(batch_iter)
+                        with fetch_ctx:
+                            batch_idx, (x, y) = next(batch_iter)
                     except StopIteration:
                         if arm_now:
                             watchdog.disarm()
@@ -1017,6 +1058,19 @@ class Trainer:
                     if should_log:
                         history["train_loss"].append((epoch, batch_idx, loss))
                         self.log.info("%d loss:  %f", batch_idx, loss)
+                    # Straggler ring: inter-iteration wall time. Dispatch
+                    # is async, so a slow DEVICE step surfaces here at
+                    # the next gated fetch (or queue backpressure) — the
+                    # jitter signal, not an extra fence. The first
+                    # interval starts AFTER the compile step completes.
+                    now_mono = time.monotonic()
+                    if prev_mono is not None:
+                        outlier = straggler.record(
+                            steps_done, now_mono - prev_mono
+                        )
+                        if outlier is not None:
+                            telemetry.emit_event("straggler", **outlier)
+                    prev_mono = now_mono
                     steps_done += 1
                     if checkpoint_due:
                         if cfg.halt_on_nonfinite:
@@ -1084,8 +1138,14 @@ class Trainer:
                     cfg.profile_start_step + cfg.profile_num_steps,
                     steps_done,
                 )
+        except BaseException as e:
+            # Crash post-mortem: the timing tail goes onto the metric
+            # stream before the run dies (KeyboardInterrupt included).
+            flight.dump("exception", error=repr(e), step=steps_done)
+            raise
         finally:
             stop_profile(None)  # exception path: close without a fence
+            flight.uninstall()
             if watchdog is not None:
                 watchdog.close()
             if ckpt is not None:

@@ -14,8 +14,10 @@ get their gradients explicitly averaged over all mesh axes.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
+import time
 from typing import Any
 
 import flax.struct
@@ -968,9 +970,10 @@ class LMTrainer:
                 # specs tell it which leaves are tensor shards (chunked
                 # per (data, tensor) coordinate) and drive the exact
                 # global-norm clip when configured.
-                params, opt_state = zero1_opt.apply(
-                    params, opt_state, grads, orig_specs
-                )
+                with jax.named_scope("graftscope/optimizer_zero1"):
+                    params, opt_state = zero1_opt.apply(
+                        params, opt_state, grads, orig_specs
+                    )
             elif compress:
                 # Quantized bucket all-reduce of the accumulated local
                 # gradient with this device's error-feedback residual
@@ -987,12 +990,18 @@ class LMTrainer:
                     bucket_bytes=bucket_bytes,
                 )
                 ef = jax.tree.map(lambda a: a[None], ef_out)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                with jax.named_scope("graftscope/optimizer"):
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
             else:
-                grads = jax.tree.map(sync_grad, grads, param_specs)
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
+                # graftscope Perfetto label for the per-leaf spec-aware
+                # pmean sync (the compressed path is labeled inside
+                # sync_grads_compressed).
+                with jax.named_scope("graftscope/sync/dp_pmean"):
+                    grads = jax.tree.map(sync_grad, grads, param_specs)
+                with jax.named_scope("graftscope/optimizer"):
+                    updates, opt_state = tx.update(grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
             if compress:
                 opt_state = (opt_state, ef)
             metrics = {"loss": loss}
@@ -1020,16 +1029,18 @@ class LMTrainer:
             metric_specs.update(
                 {"moe_aux": P(), "moe_drop": P(), "moe_load_entropy": P()}
             )
-        mapped_step = jax.jit(
-            jax.shard_map(
-                local_step,
-                mesh=self.mesh,
-                in_specs=(param_specs, opt_specs, batch_spec, batch_spec, P()),
-                out_specs=(param_specs, opt_specs, metric_specs),
-                check_vma=False,
-            ),
-            donate_argnums=(0, 1),
+        mapped_train = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(param_specs, opt_specs, batch_spec, batch_spec, P()),
+            out_specs=(param_specs, opt_specs, metric_specs),
+            check_vma=False,
         )
+        # Un-jitted, un-donated handle for instrumentation (graftscope
+        # re-jits WITHOUT donation so repeated parity/timing calls on the
+        # same (params, opt_state) don't hit deleted buffers).
+        self.mapped_train = mapped_train
+        mapped_step = jax.jit(mapped_train, donate_argnums=(0, 1))
 
         def train_step(params, opt_state, tokens, targets, step=0):
             """``step`` keys the dropout mask stream (ignored at
@@ -1238,6 +1249,21 @@ class LMTrainer:
             grad_sync_bytes_per_step=wire_bytes,
         )
 
+        # ---- flight recorder (obs/flight.py): per-step wall ring + MAD
+        # straggler detection, dumped as events on watchdog fire,
+        # uncaught exception, or SIGTERM (same wiring as the CIFAR engine).
+        from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+            FlightRecorder,
+            HbmHighWater,
+            StragglerMonitor,
+        )
+
+        straggler = StragglerMonitor()
+        flight = FlightRecorder(
+            telemetry=telemetry, straggler=straggler, hbm=HbmHighWater()
+        )
+        flight.install()
+
         watchdog = None
         if cfg.step_timeout_s:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
@@ -1245,7 +1271,9 @@ class LMTrainer:
             )
 
             watchdog = StepWatchdog(
-                cfg.step_timeout_s, metric_ring=telemetry.ring
+                cfg.step_timeout_s,
+                metric_ring=telemetry.ring,
+                flight_recorder=flight,
             )
         if cfg.halt_on_nonfinite:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
@@ -1271,10 +1299,18 @@ class LMTrainer:
         # watchdog-guarded saves) and parallel/pipeline.py::fit.
         pending_ckpt = None
         x = y = None
+        prev_mono = None  # per-step wall clock for the straggler ring
+        step = start_step
         try:
             for step in range(start_step, steps):
                 lo = (step * b) % max(n - b + 1, 1)
-                x, y = self.shard_batch(tokens[lo : lo + b])
+                fetch_ctx = (
+                    jax.profiler.TraceAnnotation("graftscope/input_fetch")
+                    if profiling_active
+                    else contextlib.nullcontext()
+                )
+                with fetch_ctx:
+                    x, y = self.shard_batch(tokens[lo : lo + b])
                 if (
                     cfg.profile_dir
                     and not profiling_active
@@ -1289,14 +1325,29 @@ class LMTrainer:
                 arm_now = watchdog is not None and step > start_step
                 if arm_now:
                     watchdog.arm()
+                step_ctx = (
+                    jax.profiler.StepTraceAnnotation("lm", step_num=step)
+                    if profiling_active
+                    else contextlib.nullcontext()
+                )
                 try:
-                    params, opt_state, m = self.train_step(
-                        params, opt_state, x, y, step
-                    )
-                    loss = float(m["loss"])
+                    with step_ctx:
+                        params, opt_state, m = self.train_step(
+                            params, opt_state, x, y, step
+                        )
+                        loss = float(m["loss"])
                 finally:
                     if arm_now:
                         watchdog.disarm()
+                # Straggler ring: inter-iteration wall time (fit fetches
+                # every loss, so each interval covers one fenced step).
+                # The first interval starts AFTER the compile step.
+                now_mono = time.monotonic()
+                if prev_mono is not None:
+                    outlier = straggler.record(step, now_mono - prev_mono)
+                    if outlier is not None:
+                        telemetry.emit_event("straggler", **outlier)
+                prev_mono = now_mono
                 if (
                     profiling_active
                     and step + 1 >= cfg.profile_start_step + cfg.profile_num_steps
@@ -1356,8 +1407,14 @@ class LMTrainer:
                 ckpt.save(
                     LMState(jnp.int32(final), params, opt_state), force=True
                 )
+        except BaseException as e:
+            # Crash post-mortem: the timing tail goes onto the metric
+            # stream before the run dies (KeyboardInterrupt included).
+            flight.dump("exception", error=repr(e), step=step)
+            raise
         finally:
             stop_profile()  # exception path: close any open capture
+            flight.uninstall()
             if watchdog is not None:
                 watchdog.close()
             if ckpt is not None:

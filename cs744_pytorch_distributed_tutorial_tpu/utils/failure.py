@@ -86,6 +86,7 @@ class StepWatchdog:
         dump_stacks: bool = True,
         metric_ring: Any | None = None,
         ring_tail: int = 32,
+        flight_recorder: Any | None = None,
     ):
         self.timeout_s = timeout_s
         self.on_hang = on_hang
@@ -97,6 +98,11 @@ class StepWatchdog:
         # training was converging (or not) toward.
         self.metric_ring = metric_ring
         self.ring_tail = ring_tail
+        # obs.flight.FlightRecorder (anything with .dump(reason, **kw)):
+        # adds the phase-timing tail and straggler stats to the report —
+        # the ring says what the LOSS was doing, the flight recorder
+        # says what the STEP TIMES were doing before the hang.
+        self.flight_recorder = flight_recorder
         self.fired = 0  # total hang detections (for tests/metrics)
         self._log = get_logger()
         self._cv = threading.Condition()
@@ -183,6 +189,13 @@ class StepWatchdog:
                 )
                 for rec in records:
                     self._log.critical("watchdog:   %s", json.dumps(rec, default=str))
+        if self.flight_recorder is not None:
+            try:
+                self.flight_recorder.dump(
+                    "watchdog", elapsed_s=elapsed_s, timeout_s=timeout_s
+                )
+            except Exception as e:  # never let telemetry break the report
+                self._log.critical("watchdog: flight recorder dump failed: %r", e)
         if self.on_hang is not None:
             self.on_hang(elapsed_s)
 

@@ -69,77 +69,16 @@ def device_op_breakdown(
     durations aggregated by op name, averaged over ``iters``, sorted
     descending. Completion is fenced by fetching a concrete scalar (NOT
     ``block_until_ready`` — unreliable on the tunneled backend).
+
+    Thin shim over ``obs.phases.capture_device_profile`` — graftscope's
+    phase profiler and this breakdown share ONE warm-up/fence/trace-parse
+    path (the interval-union nesting logic lives there).
     """
-    import collections
-    import glob
-    import gzip
-    import json
-    import os
-    import shutil
-    import tempfile
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        capture_device_profile,
+    )
 
-    def fence(out) -> None:
-        leaf = jax.tree.leaves(out)[0]
-        float(leaf.ravel().astype("float32")[0])
-
-    fence(fn(*args))  # compile outside the trace
-    owns_dir = trace_dir is None
-    d = trace_dir or tempfile.mkdtemp(prefix="jax_op_breakdown_")
-    try:
-        with jax.profiler.trace(d):
-            out = None
-            for _ in range(iters):
-                out = fn(*args)
-            fence(out)
-        paths = sorted(
-            glob.glob(os.path.join(d, "plugins/profile/*/*.trace.json.gz"))
-        )
-        if not paths:
-            raise RuntimeError(f"no trace produced under {d}")
-        with gzip.open(paths[-1]) as f:
-            events = json.load(f)["traceEvents"]
-        pids = {}
-        for e in events:
-            if e.get("ph") == "M" and e.get("name") == "process_name":
-                pids[e["pid"]] = e["args"].get("name", "")
-        durs: collections.Counter = collections.Counter()
-        by_lane: dict = collections.defaultdict(list)
-        for e in events:
-            pname = pids.get(e.get("pid"), "")
-            device_lane = (
-                "TPU" in pname or "device" in pname.lower() or "/gpu" in pname
-            )
-            if e.get("ph") == "X" and e.get("dur") and device_lane:
-                durs[e["name"]] += e["dur"]
-                by_lane[e.get("pid")].append((e.get("ts", 0.0), e["dur"]))
-        rows = sorted(
-            ((v / iters / 1e3, k) for k, v in durs.items()), reverse=True
-        )
-        # Per-iter total: sum of TOP-LEVEL device events only. Trace rows
-        # nest (a jit_ program contains its op rows; nested jits contain
-        # their callees), so summing every event double-counts
-        # parent+child, and "largest jit_ entry" under-counts when fn
-        # dispatches several programs back-to-back. Nesting is computed
-        # per device PID across all its tids: XLA puts the jit_ module
-        # event and its op events on DIFFERENT threads of the same
-        # device process, so per-(pid, tid) lanes would count both in
-        # full. Sort ties by -dur so a parent sharing its first child's
-        # start timestamp wins the top-level slot.
-        total_us = 0.0
-        for lane in by_lane.values():
-            lane.sort(key=lambda td: (td[0], -td[1]))
-            end = float("-inf")
-            for ts, dur in lane:
-                if ts >= end:
-                    total_us += dur
-                    end = ts + dur
-                elif ts + dur > end:
-                    # Overlapping but not nested (e.g. a DMA straddling
-                    # a module boundary): count only the tail beyond the
-                    # current busy span — a true interval union.
-                    total_us += ts + dur - end
-                    end = ts + dur
-        return total_us / iters / 1e3, rows[:top]
-    finally:
-        if owns_dir:
-            shutil.rmtree(d, ignore_errors=True)
+    prof = capture_device_profile(
+        fn, *args, iters=iters, top=top, trace_dir=trace_dir
+    )
+    return prof.device_ms, prof.op_rows

@@ -96,3 +96,265 @@ def test_device_op_breakdown_cpu():
     # validated by hand in benchmarks/ablate.py round-2 notes
     for ms, name in rows:
         assert ms >= 0.0 and isinstance(name, str)
+
+
+# ---------------------------------------------------------------------------
+# graftscope: segmented-step phase attribution (obs/phases.py)
+# ---------------------------------------------------------------------------
+
+
+def _cifar_step_inputs(mesh, cfg):
+    """(trainer, state, x, y, key) — the canonical parity-suite recipe."""
+    import jax
+
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+
+    tr = Trainer(cfg, mesh=mesh)
+    state = tr.init()
+    ds = synthetic_cifar10(cfg.global_batch_size, 8, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    return tr, state, x, y, jax.random.key(cfg.seed)
+
+
+@pytest.mark.parametrize(
+    "sync,compress,overrides",
+    [
+        ("allreduce", "none", {}),  # bucketed flat allreduce (default)
+        ("allreduce", "none", {"sync_bucket_mb": 0}),  # per-leaf
+        ("ring", "none", {}),
+        ("allreduce", "int8", {}),
+    ],
+    ids=["allreduce", "allreduce-perleaf", "ring", "int8"],
+)
+def test_segmented_fused_parity_cifar(mesh4, sync, compress, overrides):
+    """The segmented profiled step (forward/grads | sync | opt as separate
+    jitted programs) must produce the SAME loss and params as the fused
+    fast path — same tolerance discipline as test_sync_parity."""
+    import jax
+    import numpy as np
+
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        PARITY_ATOL,
+        PARITY_LOSS_RTOL,
+        PARITY_RTOL,
+        build_cifar_segments,
+    )
+
+    cfg = TrainConfig(
+        **TINY_DP4_CFG, sync=sync, grad_compress=compress,
+        compute_dtype="float32", **overrides,
+    )
+    tr, state, x, y, key = _cifar_step_inputs(mesh4, cfg)
+    segs = build_cifar_segments(tr)
+    new_f, m_f = segs.fused(state, x, y, key)
+    new_s, loss_s = segs.segmented_step(state, x, y, key)
+    loss_f = float(m_f["loss"])
+    assert abs(float(loss_s) - loss_f) <= PARITY_LOSS_RTOL * max(
+        1.0, abs(loss_f)
+    )
+    for a, b in zip(
+        jax.tree.leaves(new_f.params), jax.tree.leaves(new_s.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=PARITY_RTOL, atol=PARITY_ATOL
+        )
+
+
+@pytest.mark.parametrize("compress", ["none", "int8"])
+def test_segmented_fused_parity_lm(compress):
+    """Same contract on the LM engine (pure-DP configs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        PARITY_ATOL,
+        PARITY_LOSS_RTOL,
+        PARITY_RTOL,
+        build_lm_segments,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import LMConfig, LMTrainer
+
+    cfg = LMConfig(
+        vocab_size=64, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_seq_len=16, seq_len=16, global_batch_size=8, data_parallel=4,
+        seq_parallel=1, grad_compress=compress,
+    )
+    tr = LMTrainer(cfg)
+    params, opt_state = tr.init()
+    import numpy as _np
+
+    toks = _np.random.RandomState(0).randint(0, 64, size=(8, 17))
+    x, y = tr.shard_batch(toks)
+    segs = build_lm_segments(tr)
+    step = jnp.int32(0)
+    new_p, _new_o, m_f = segs.fused(params, opt_state, x, y, step)
+    (seg_p, _seg_o), loss_s = segs.segmented_step(params, opt_state, x, y, step)
+    loss_f = float(m_f["loss"])
+    assert abs(float(loss_s) - loss_f) <= PARITY_LOSS_RTOL * max(
+        1.0, abs(loss_f)
+    )
+    for a, b in zip(jax.tree.leaves(new_p), jax.tree.leaves(seg_p)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=PARITY_RTOL, atol=PARITY_ATOL
+        )
+
+
+def test_cifar_segments_reject_sharded_optimizers(mesh4):
+    """Segmentation only covers the plain-DP step; sharded-state configs
+    must fail loudly, not silently mis-attribute."""
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        build_cifar_segments,
+    )
+
+    cfg = TrainConfig(**TINY_DP4_CFG, sync="zero1")
+    tr = Trainer(cfg, mesh=mesh4)
+    with pytest.raises(ValueError, match="sync='zero1'"):
+        build_cifar_segments(tr)
+
+
+def test_profile_phases_end_to_end(mesh4):
+    """profile_phases: parity gate + the four-phase report with
+    sink-ready records and a renderable table."""
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        PHASE_NAMES,
+        phase_records_from_stream,
+        profile_phases,
+        render_phase_table,
+    )
+
+    cfg = TrainConfig(
+        **TINY_DP4_CFG, sync="allreduce", compute_dtype="float32"
+    )
+    tr, state, x, y, key = _cifar_step_inputs(mesh4, cfg)
+    report = profile_phases(tr, state, x, y, key, iters=1)
+    assert report.parity_ok
+    assert tuple(p.name for p in report.phases) == PHASE_NAMES
+    assert report.sync_exposed_ms >= 0.0
+    assert report.phase("grad_sync").comm_bytes > 0
+    assert report.phase("grad_sync").roofline == "comms"
+    records = report.records(run="test")
+    assert len(phase_records_from_stream(records)) == len(PHASE_NAMES) + 1
+    table = render_phase_table(records)
+    assert "grad_sync" in table and "sync_exposed_ms" in table
+
+
+# ---------------------------------------------------------------------------
+# graftscope: straggler monitor + flight recorder (obs/flight.py)
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_monitor_flags_seeded_outlier():
+    from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+        StragglerMonitor,
+    )
+
+    mon = StragglerMonitor(min_samples=16, mad_k=5.0)
+    outliers = []
+    for step in range(64):
+        wall = 0.102 if step % 2 else 0.098  # jittery but tight
+        if step == 50:
+            wall = 1.5  # the seeded straggler
+        out = mon.record(step, wall)
+        if out is not None:
+            outliers.append(out)
+    assert [o["step"] for o in outliers] == [50]
+    assert outliers[0]["wall_s"] == 1.5
+    assert outliers[0]["excess_sigma"] > 0
+    stats = mon.stats()
+    assert stats["outlier_count"] == 1
+    assert stats["max_s"] == 1.5
+    assert mon.tail(4)[-1]["step"] == 63
+
+
+def test_straggler_monitor_quiet_on_uniform_and_warmup():
+    """No outliers on uniform timing, and never before min_samples — the
+    first post-compile steps must not page anyone."""
+    from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+        StragglerMonitor,
+    )
+
+    mon = StragglerMonitor(min_samples=16)
+    assert mon.record(0, 30.0) is None  # huge compile step: under warmup
+    for step in range(1, 64):
+        assert mon.record(step, 0.1) is None
+
+
+def test_flight_recorder_dumps_on_watchdog():
+    """StepWatchdog(flight_recorder=...) fires -> structured flight_dump
+    event records land on the sink, tail first."""
+    import time
+
+    from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+        FlightRecorder,
+        StragglerMonitor,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+        StepWatchdog,
+    )
+
+    events = []
+
+    def emit(event, **fields):
+        events.append({"event": event, **fields})
+
+    mon = StragglerMonitor(min_samples=2)
+    for step in range(8):
+        mon.record(step, 0.1)
+    fr = FlightRecorder(straggler=mon, emit=emit)
+    wd = StepWatchdog(timeout_s=0.05, dump_stacks=False, flight_recorder=fr)
+    try:
+        wd.arm()
+        deadline = time.monotonic() + 5.0
+        while wd.fired == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        wd.disarm()
+    finally:
+        wd.close()
+    assert wd.fired >= 1
+    assert fr.dumps >= 1
+    dump = [e for e in events if e["event"] == "flight_dump"]
+    assert dump and dump[0]["reason"] == "watchdog"
+    assert dump[0]["straggler_steps_recorded"] == 8
+    steps = [e for e in events if e["event"] == "flight_step"]
+    assert steps and steps[-1]["step"] == 7
+
+
+def test_flight_recorder_excepthook_chains():
+    """install() wraps sys.excepthook: a dump happens AND the previous
+    hook still runs; uninstall() restores it."""
+    import sys
+
+    from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+        FlightRecorder,
+    )
+
+    events = []
+    seen = []
+    prev_hook = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    try:
+        fr = FlightRecorder(emit=lambda event, **f: events.append(event))
+        fr.install(sigterm=False, excepthook=True)
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        assert "flight_dump" in events
+        assert len(seen) == 1  # the chained original hook ran
+        fr.uninstall()
+        assert sys.excepthook is not fr and len(events) >= 1
+    finally:
+        sys.excepthook = prev_hook
+
+
+def test_flight_recorder_requires_a_sink():
+    from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+        FlightRecorder,
+    )
+
+    with pytest.raises(ValueError):
+        FlightRecorder()
