@@ -106,9 +106,10 @@ def _bench_at(
     steps: int = MEASURE_STEPS,
     sync: str = "auto",
     grad_compress: str = "none",
+    sync_overlap: str = "off",
 ) -> tuple[float, int]:
     """(samples/sec/chip, analytic gradient-sync payload bytes sent per
-    device per step) for the given sync strategy/compression."""
+    device per step) for the given sync strategy/compression/overlap."""
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
@@ -125,6 +126,7 @@ def _bench_at(
         model="resnet18",
         sync=sync,
         grad_compress=grad_compress,
+        sync_overlap=sync_overlap,
         num_devices=n_chips,
         global_batch_size=batch,
         compute_dtype="bfloat16",
@@ -137,6 +139,7 @@ def _bench_at(
         state.params,
         "int8_allreduce" if trainer._compress else sync,
         n_chips,
+        reverse=trainer._overlap,
     )
     ds = synthetic_cifar10(batch, 16, seed=0)
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
@@ -146,50 +149,87 @@ def _bench_at(
 
 
 def sync_compare(
-    sink, batch: int = BATCH_SMALL, steps: int = MEASURE_STEPS
+    sink,
+    batch: int = BATCH_SMALL,
+    steps: int = MEASURE_STEPS,
+    *,
+    phase_iters: int = 3,
 ) -> None:
     """Bytes-on-wire mode: samples/sec/chip AND analytic gradient payload
     bytes sent per device per step, one JSON line per sync setting —
     f32 per-leaf ('auto', the DDP analog), f32 bucketed flat allreduce,
-    and the int8-quantized bucket allreduce with error feedback."""
-    for label, sync, compress in (
-        ("f32_per_leaf_auto", "auto", "none"),
-        ("f32_bucketed_allreduce", "allreduce", "none"),
-        ("int8_bucketed_allreduce", "allreduce", "int8"),
-    ):
+    and the int8-quantized bucket allreduce with error feedback. The
+    bucketed rows also carry their OVERLAPPED throughput
+    (``--sync-overlap``, parallel/overlap.py), and each overlapped wire
+    gets one ``kind="sync_compare"`` record comparing fused vs
+    overlapped step wall and the sync_exposed_ms each leaves on the
+    table (graftscope's attribution, obs/phases.py)."""
+    rows = (
+        ("f32_per_leaf_auto", "auto", "none", None),
+        ("f32_bucketed_allreduce", "allreduce", "none", "bucket"),
+        ("int8_bucketed_allreduce", "allreduce", "int8", "bucket+int8"),
+    )
+    for label, sync, compress, ov in rows:
         sps, wire = _bench_at(batch, steps, sync=sync, grad_compress=compress)
+        rec = {
+            "kind": "bench",
+            "time": time.time(),
+            "metric": "cifar10_resnet18_grad_sync",
+            "sync": label,
+            "batch": batch,
+            "samples_per_sec_per_chip": round(sps, 1),
+            "grad_sync_bytes_per_step": wire,
+        }
+        if ov is not None:
+            sps_ov, _ = _bench_at(
+                batch, steps, sync=sync, grad_compress=compress,
+                sync_overlap=ov,
+            )
+            rec["sync_overlap"] = ov
+            rec["samples_per_sec_per_chip_overlap"] = round(sps_ov, 1)
+        sink.emit(rec)
+    for label, sync, compress, ov in rows:
+        if ov is None:
+            continue
+        rep_f, _ = _phase_report(
+            batch, model="resnet18", sync=sync, grad_compress=compress,
+            compute_dtype="bfloat16", iters=phase_iters,
+        )
+        rep_o, _ = _phase_report(
+            batch, model="resnet18", sync=sync, grad_compress=compress,
+            compute_dtype="bfloat16", sync_overlap=ov, iters=phase_iters,
+        )
         sink.emit(
             {
-                "kind": "bench",
+                "kind": "sync_compare",
                 "time": time.time(),
-                "metric": "cifar10_resnet18_grad_sync",
-                "sync": label,
+                "metric": "cifar10_resnet18_sync_overlap",
+                "wire": label,
+                "sync_overlap": ov,
                 "batch": batch,
-                "samples_per_sec_per_chip": round(sps, 1),
-                "grad_sync_bytes_per_step": wire,
+                "fused_step_ms": round(rep_f.fused_ms, 4),
+                "overlap_step_ms": round(rep_o.fused_ms, 4),
+                "sync_exposed_ms_fused": round(rep_f.sync_exposed_ms, 4),
+                "sync_exposed_ms_overlap": round(rep_o.sync_exposed_ms, 4),
+                "parity_ok": bool(rep_f.parity_ok and rep_o.parity_ok),
             }
         )
 
 
-def phase_breakdown(
-    sink,
-    batch: int = GLOBAL_BATCH,
+def _phase_report(
+    batch: int,
     *,
     model: str = "resnet18",
     sync: str = "auto",
     grad_compress: str = "none",
     compute_dtype: str = "bfloat16",
+    sync_overlap: str = "off",
     iters: int = 3,
-    metrics_dir: str | None = None,
-) -> bool:
-    """graftscope mode (obs/phases.py): compile forward / backward /
-    grad-sync / optimizer as separate fenced segments, parity-check the
-    segmented step against the fused fast path, and emit per-phase
-    device time, flops, bytes, MFU, roofline class, and
-    ``sync_exposed_ms`` — the optimization target for the sync-overlap
-    work (ROADMAP item 2). Returns parity_ok (the caller exits nonzero
-    on False: attribution of a step that computes something else is
-    not a benchmark)."""
+):
+    """Build a trainer for the given sync configuration and run the
+    graftscope segmented profile (obs/phases.py). Returns
+    ``(PhaseReport, n_chips)``; shared by ``--phase-breakdown`` and the
+    overlap comparison inside ``--sync-compare``."""
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
     from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
@@ -206,6 +246,7 @@ def phase_breakdown(
         model=model,
         sync=sync,
         grad_compress=grad_compress,
+        sync_overlap=sync_overlap,
         num_devices=n_chips,
         global_batch_size=batch,
         compute_dtype=compute_dtype,
@@ -217,7 +258,38 @@ def phase_breakdown(
     ds = synthetic_cifar10(batch, 16, seed=0)
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
-    report = profile_phases(trainer, state, x, y, key, iters=iters)
+    return profile_phases(trainer, state, x, y, key, iters=iters), n_chips
+
+
+def phase_breakdown(
+    sink,
+    batch: int = GLOBAL_BATCH,
+    *,
+    model: str = "resnet18",
+    sync: str = "auto",
+    grad_compress: str = "none",
+    compute_dtype: str = "bfloat16",
+    sync_overlap: str = "off",
+    iters: int = 3,
+    metrics_dir: str | None = None,
+) -> bool:
+    """graftscope mode (obs/phases.py): compile forward / backward /
+    grad-sync / optimizer as separate fenced segments, parity-check the
+    segmented step against the fused fast path, and emit per-phase
+    device time, flops, bytes, MFU, roofline class, and
+    ``sync_exposed_ms`` — the optimization target for the sync-overlap
+    work (ROADMAP item 2). Returns parity_ok (the caller exits nonzero
+    on False: attribution of a step that computes something else is
+    not a benchmark)."""
+    report, n_chips = _phase_report(
+        batch,
+        model=model,
+        sync=sync,
+        grad_compress=grad_compress,
+        compute_dtype=compute_dtype,
+        sync_overlap=sync_overlap,
+        iters=iters,
+    )
     now = time.time()
     for rec in report.records(run=f"bench_{model}"):
         sink.emit({**rec, "time": now})
@@ -232,6 +304,7 @@ def phase_breakdown(
             "value": round(batch / (report.fused_ms / 1e3) / n_chips, 1),
             "unit": "samples/sec/chip",
             "batch": batch,
+            "sync_overlap": sync_overlap,
             "sync_exposed_ms": round(report.sync_exposed_ms, 4),
             "parity_ok": report.parity_ok,
         }
@@ -279,6 +352,13 @@ def _parse_args() -> argparse.Namespace:
         help="gradient compression for --phase-breakdown",
     )
     p.add_argument(
+        "--sync-overlap", default="off",
+        choices=("off", "bucket", "bucket+int8"),
+        help="overlapped bucket sync schedule for --phase-breakdown "
+        "(parallel/overlap.py; 'bucket' needs --grad-compress none, "
+        "'bucket+int8' needs --grad-compress int8)",
+    )
+    p.add_argument(
         "--compute-dtype", default="bfloat16",
         help="compute dtype for --phase-breakdown (default %(default)s; "
         "float32 keeps the parity check at the strict f32 tolerance)",
@@ -308,6 +388,7 @@ def main() -> None:
                 sync=args.sync,
                 grad_compress=args.grad_compress,
                 compute_dtype=args.compute_dtype,
+                sync_overlap=args.sync_overlap,
                 iters=args.phase_iters,
                 metrics_dir=args.metrics_dir,
             )

@@ -71,6 +71,19 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         if r.get("kind") == "phase_summary"
         and isinstance(r.get("sync_exposed_ms"), (int, float))
     ]
+    # Fused-vs-overlapped sync comparison rows (bench.py --sync-compare):
+    # one row per wire format, latest record wins on repeat runs.
+    sync_compare: dict[str, dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") == "sync_compare" and isinstance(
+            r.get("wire"), str
+        ):
+            sync_compare[r["wire"]] = {
+                k: r.get(k)
+                for k in ("sync_overlap", "fused_step_ms", "overlap_step_ms",
+                          "sync_exposed_ms_fused", "sync_exposed_ms_overlap",
+                          "parity_ok")
+            }
     return {
         "records": len(records),
         "step_records": len(steps),
@@ -85,6 +98,7 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "events": sorted({e.get("event") for e in events}),
         "phases": phases,
         "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
+        "sync_compare": sync_compare,
     }
 
 
@@ -126,6 +140,15 @@ def main(argv: list[str] | None = None) -> int:
         ))
     if summary["sync_exposed_ms"] is not None:
         rows.append(("sync exposed (ms)", summary["sync_exposed_ms"]))
+    for wire, row in summary["sync_compare"].items():
+        rows.append((
+            f"overlap {wire}",
+            f"step {_fmt(row['fused_step_ms'])} -> "
+            f"{_fmt(row['overlap_step_ms'])} ms, sync exposed "
+            f"{_fmt(row['sync_exposed_ms_fused'])} -> "
+            f"{_fmt(row['sync_exposed_ms_overlap'])} ms "
+            f"({_fmt(row['sync_overlap'])})",
+        ))
     width = max(len(name) for name, _ in rows)
     for name, val in rows:
         print(f"{name:<{width}}  {_fmt(val)}")

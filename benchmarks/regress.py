@@ -10,7 +10,11 @@ and fails when the current value drops more than ``--tolerance`` below
 it. When BOTH sides carry graftscope ``phase_summary`` records, the
 ``sync_exposed_ms`` metric is gated too (higher-is-worse, its own
 tolerance) — so a sync-overlap win (ROADMAP item 2), once landed,
-cannot silently regress.
+cannot silently regress. Independently, any baseline record carrying
+``sync_exposed_budget_ms`` (the checked-in
+``benchmarks/perf_smoke_budget.json`` envelope) arms an ABSOLUTE
+ceiling on the current stream's sync_exposed_ms — the on-by-default CI
+gate for the overlapped bucket schedule (``--sync-overlap``).
 
 Exit codes: 0 pass, 1 regression, 2 missing/unusable data (a gate that
 can't find its numbers must fail loudly, not pass vacuously).
@@ -97,6 +101,20 @@ def sync_exposed_values(records: list[dict[str, Any]]) -> list[float]:
     return vals
 
 
+def sync_exposed_budget(records: list[dict[str, Any]]) -> float | None:
+    """Absolute sync_exposed_ms ceiling carried by the baseline side.
+
+    A checked-in budget envelope (``benchmarks/perf_smoke_budget.json``)
+    carries ``sync_exposed_budget_ms``; its presence among the baseline
+    records ARMS the budget gate — no extra CLI flag needed, so the CI
+    perf-smoke job gates sync_exposed_ms by default. Last value wins."""
+    budget = None
+    for r in records:
+        if isinstance(r.get("sync_exposed_budget_ms"), (int, float)):
+            budget = float(r["sync_exposed_budget_ms"])
+    return budget
+
+
 def evaluate(
     baseline_records: list[dict[str, Any]],
     current_records: list[dict[str, Any]],
@@ -151,6 +169,26 @@ def evaluate(
             )
             if not verdict["sync_exposed_ok"]:
                 code = REGRESSION
+
+    budget = sync_exposed_budget(baseline_records)
+    if budget is not None:
+        cur_sync = sync_exposed_values(current_records)
+        if not cur_sync:
+            # An armed budget with nothing to gate is missing data, not
+            # a pass — the CI stream must carry phase_summary records.
+            verdict["error"] = (
+                "sync_exposed_budget_ms armed but the current stream has "
+                "no phase_summary records"
+            )
+            return MISSING, verdict
+        c = cur_sync[-1]
+        verdict.update(
+            sync_exposed_budget_ms=budget,
+            sync_exposed_current_ms=c,
+            sync_budget_ok=c <= budget,
+        )
+        if not verdict["sync_budget_ok"]:
+            code = REGRESSION
     return code, verdict
 
 
@@ -221,6 +259,13 @@ def main(argv: list[str] | None = None) -> int:
                 f"{verdict['sync_exposed_current_ms']:.3f} vs baseline "
                 f"{verdict['sync_exposed_baseline_ms']:.3f} (ceiling "
                 f"{verdict['sync_exposed_ceiling_ms']:.3f})"
+            )
+        if "sync_budget_ok" in verdict:
+            print(
+                f"regress [{'PASS' if verdict['sync_budget_ok'] else 'FAIL'}] "
+                f"sync_exposed_ms budget: current "
+                f"{verdict['sync_exposed_current_ms']:.3f} vs budget "
+                f"{verdict['sync_exposed_budget_ms']:.3f}"
             )
     return code
 

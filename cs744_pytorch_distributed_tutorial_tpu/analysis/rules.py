@@ -1,4 +1,4 @@
-"""graftlint rules GL001–GL008.
+"""graftlint rules GL001–GL009.
 
 Each rule is a callable ``check(ctx) -> Iterator[Finding]`` over a
 :class:`~.context.ModuleContext`. Rules are deliberately heuristic —
@@ -936,6 +936,86 @@ def check_dead_import(ctx: ModuleContext) -> Iterator[Finding]:
         )
 
 
+# ======================================================================= GL009
+def check_blocking_sync_in_step_loop(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL009 blocking-sync-in-step-loop.
+
+    ``jax.block_until_ready(...)`` (or the array-method form) and
+    ``jax.device_get(...)`` on the hot path of a host step loop that
+    drives a known jit-wrapped callable. JAX dispatch is asynchronous —
+    the loop's job is to keep the device queue full, and a blocking
+    wait between one dispatch and the next (gradients vs optimizer
+    apply, or step i vs step i+1) drains the pipeline, re-serializing
+    exactly the backward->apply window the overlapped bucket schedule
+    (``--sync-overlap``, parallel/overlap.py) exists to hide. Calls
+    behind a cadence gate (``if step % k == 0:``) are not flagged —
+    fetching occasionally is the sanctioned pattern (obs/telemetry).
+    """
+    rule, name = "GL009", "blocking-sync-in-step-loop"
+    if not ctx.jit_registry:
+        return
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        if ctx.in_traced_scope(loop):
+            continue
+        # Only OUTERMOST step loops, same as GL001's step-loop scan.
+        anc = ctx.parent.get(loop)
+        is_nested = False
+        while anc is not None and not isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            if isinstance(anc, (ast.For, ast.While)):
+                is_nested = True
+                break
+            anc = ctx.parent.get(anc)
+        if is_nested:
+            continue
+        if not any(
+            _is_jit_call(c, ctx.jit_registry)
+            for c in ast.walk(loop)
+            if isinstance(c, ast.Call)
+        ):
+            continue
+        for call in ast.walk(loop):
+            if not isinstance(call, ast.Call):
+                continue
+            dotted = ctx.resolve(call.func)
+            if dotted in ("jax.block_until_ready", "jax.device_get"):
+                label = f"{dotted}()"
+            elif (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "block_until_ready"
+                and not call.args
+            ):
+                label = "'.block_until_ready()'"
+            else:
+                continue
+            if _under_cadence_gate(ctx, call, loop):
+                continue
+            yield _finding(
+                ctx,
+                call,
+                rule,
+                name,
+                f"{label} on the step-loop hot path blocks until the "
+                "device queue drains, re-serializing the backward->"
+                "optimizer-apply window the overlapped sync schedule "
+                "hides; fetch behind a cadence gate or drop the wait",
+            )
+
+
+def _under_cadence_gate(
+    ctx: ModuleContext, node: ast.AST, loop: ast.AST
+) -> bool:
+    cur = ctx.parent.get(node)
+    while cur is not None and cur is not loop:
+        if isinstance(cur, ast.If):
+            return True
+        cur = ctx.parent.get(cur)
+    return False
+
+
 ALL_RULES: dict[str, RuleFn] = {
     "GL001": check_host_sync,
     "GL002": check_retrace_hazard,
@@ -945,4 +1025,5 @@ ALL_RULES: dict[str, RuleFn] = {
     "GL006": check_mutable_default,
     "GL007": check_time_in_trace,
     "GL008": check_dead_import,
+    "GL009": check_blocking_sync_in_step_loop,
 }

@@ -41,6 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-bucket-mb", type=float, default=None,
                    help="bucket size (MiB) for coalesced gradient sync; "
                         "0 = per-leaf collectives (default 4)")
+    p.add_argument("--sync-overlap", choices=["off", "bucket", "bucket+int8"],
+                   default=None,
+                   help="overlapped gradient sync (parallel/overlap.py): "
+                        "reverse-layer-order buckets dispatch each "
+                        "collective as backward produces its gradients, "
+                        "with the SGD update applied per bucket; 'bucket' "
+                        "overlaps the float wire (allreduce/ring), "
+                        "'bucket+int8' the int8+EF wire")
     p.add_argument("--model", default=None, help="model name (default vgg11)")
     p.add_argument("--image-size", type=int, default=None,
                    help="square input resolution (default 32; >64 selects "
@@ -140,6 +148,7 @@ _ARG_TO_FIELD = {
     "sync": "sync",
     "grad_compress": "grad_compress",
     "sync_bucket_mb": "sync_bucket_mb",
+    "sync_overlap": "sync_overlap",
     "model": "model",
     "fast_conv": "fast_conv",
     "augment": "augment",

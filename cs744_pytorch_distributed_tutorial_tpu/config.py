@@ -109,6 +109,15 @@ class TrainConfig:
     # megabytes instead of one per parameter leaf (DDP's bucketing
     # reducer). 0 disables bucketing (per-leaf collectives).
     sync_bucket_mb: float = 4.0
+    # Overlapped gradient sync (parallel/overlap.py): reverse-layer-order
+    # buckets whose collectives dispatch as backward produces each
+    # bucket's gradients, with the SGD update applied per bucket as its
+    # sync completes — DDP's reducer schedule as dataflow. "bucket"
+    # overlaps the float wire (sync in {allreduce, ring});
+    # "bucket+int8" overlaps the int8+EF compressed wire. Requires the
+    # reference's fixed-LR SGD recipe (optimizer="sgd", constant lr, no
+    # warmup/clip), accum_steps=1, and no zero1/fsdp/fused_optimizer.
+    sync_overlap: str = "off"  # "off" | "bucket" | "bucket+int8"
 
     # Numerics: params/BN stats stay float32; compute dtype is the MXU knob.
     compute_dtype: str = "float32"  # "bfloat16" on real TPU runs

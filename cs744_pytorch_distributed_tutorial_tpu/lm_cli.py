@@ -138,6 +138,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sync-bucket-mb", type=float, default=4.0,
                    help="bucket size (MiB) for the compressed sync's "
                         "coalesced buffers")
+    p.add_argument("--sync-overlap", choices=["off", "bucket", "bucket+int8"],
+                   default="off",
+                   help="overlapped gradient sync (parallel/overlap.py): "
+                        "reverse-layer-order buckets, per-bucket collective "
+                        "+ per-bucket SGD apply (pure-DP layouts, "
+                        "--optimizer sgd with constant lr); 'bucket+int8' "
+                        "overlaps the int8+EF wire (--grad-compress int8)")
     p.add_argument("--label-smoothing", type=float, default=0.0)
     p.add_argument("--dropout-rate", type=float, default=0.0,
                    help="residual dropout on each block's sublayer "
@@ -283,6 +290,9 @@ def _run_pipeline(args, tokens, vocab: int) -> int:
         ("--grad-compress", args.grad_compress, "none",
          "stage grads cross the pipe axis per 1F1B group, not as one "
          "flat data-parallel bucket sync"),
+        ("--sync-overlap", args.sync_overlap, "off",
+         "the overlapped bucket schedule models the shard_map engines' "
+         "pure data-parallel sync, not per-stage pipeline grads"),
         ("--metrics-dir", args.metrics_dir, None,
          "PipelineLMConfig has no telemetry fields; the obs/ sinks wire "
          "through the shard_map engines only"),
@@ -514,6 +524,7 @@ def main(argv: list[str] | None = None) -> int:
         grad_clip_norm=args.grad_clip_norm,
         grad_compress=args.grad_compress,
         sync_bucket_mb=args.sync_bucket_mb,
+        sync_overlap=args.sync_overlap,
         label_smoothing=args.label_smoothing,
         dropout_rate=args.dropout_rate,
         accum_steps=args.accum_steps,

@@ -506,6 +506,11 @@ class CifarSegments:
             TrainState,
         )
 
+        from cs744_pytorch_distributed_tutorial_tpu.parallel import (
+            buckets as _B,
+            overlap as _OV,
+        )
+
         cfg = trainer.cfg
         if cfg.accum_steps != 1:
             raise ValueError(
@@ -522,6 +527,7 @@ class CifarSegments:
             )
         self.trainer = trainer
         self.compress = trainer._compress
+        self.overlap = getattr(trainer, "_overlap", False)
         axis_size = trainer.axis_size
         model, tx = trainer.model, trainer.tx
         bucket_bytes = trainer._bucket_bytes
@@ -617,6 +623,93 @@ class CifarSegments:
                 ef=ef_stacked,
             )
 
+        # Overlapped-schedule segments (parallel/overlap.py): the same
+        # reverse-order bucket layout and per-bucket kernels the fused
+        # overlapped step runs, split at the sync/apply boundary. Buckets
+        # are independent, so all-syncs-then-all-applies is bitwise the
+        # fused interleaving; the per-bucket named scopes give the sync
+        # segment's trace the same bucketNN lanes as the fused program.
+        ov_name = wire_name if self.compress else explicit_sync
+
+        def ov_layout(tree):
+            return _OV.overlap_layout(
+                tree,
+                explicit_sync,
+                axis_size,
+                bucket_bytes,
+                compressed=self.compress,
+            )
+
+        def seg_sync_overlap(grads_stacked):
+            g = jax.tree.map(lambda a: a[0], grads_stacked)
+            layout = ov_layout(g)
+            bufs = _B.flatten_for_sync(g, layout)
+            synced = []
+            for k, buf in enumerate(bufs):
+                with jax.named_scope(
+                    f"graftscope/sync/overlap/{ov_name}/bucket{k:02d}"
+                ):
+                    synced.append(
+                        _OV.sync_bucket(buf, explicit_sync, DATA_AXIS, axis_size)
+                    )
+            return _B.unflatten(synced, layout)
+
+        def seg_sync_overlap_compressed(grads_stacked, ef_stacked):
+            g = jax.tree.map(lambda a: a[0], grads_stacked)
+            e = jax.tree.map(lambda a: a[0], ef_stacked)
+            layout = ov_layout(g)
+            g_bufs = _B.flatten_for_sync(g, layout)
+            e_bufs = _B.flatten_for_sync(e, layout)
+            synced, new_e = [], []
+            for k, (gbuf, ebuf) in enumerate(zip(g_bufs, e_bufs)):
+                with jax.named_scope(
+                    f"graftscope/sync/overlap/{ov_name}/bucket{k:02d}"
+                ):
+                    s, resid = _OV.sync_bucket_compressed(
+                        gbuf, ebuf, ov_name, DATA_AXIS, axis_size
+                    )
+                synced.append(s)
+                new_e.append(resid)
+            ef_out = _B.unflatten(new_e, layout)
+            return (
+                _B.unflatten(synced, layout),
+                jax.tree.map(lambda a: a[None], ef_out),
+            )
+
+        def seg_opt_overlap(state, synced, stats_stacked, ef_stacked):
+            trace, rebuild = _OV.split_momentum(state.opt_state)
+            layout = ov_layout(synced)
+            p_bufs = _B.flatten_for_sync(state.params, layout)
+            t_bufs = _B.flatten_for_sync(trace, layout)
+            s_bufs = _B.flatten_for_sync(synced, layout)
+            new_p, new_t = [], []
+            for k, (p, t, s) in enumerate(zip(p_bufs, t_bufs, s_bufs)):
+                with jax.named_scope(
+                    f"graftscope/optimizer/overlap/bucket{k:02d}"
+                ):
+                    pn, tn = _OV.apply_bucket(
+                        p,
+                        t,
+                        s,
+                        lr=cfg.learning_rate,
+                        momentum=cfg.momentum,
+                        weight_decay=cfg.weight_decay,
+                    )
+                new_p.append(pn)
+                new_t.append(tn)
+            return TrainState(
+                step=state.step + 1,
+                params=_B.unflatten(new_p, layout),
+                batch_stats=stats_stacked,
+                opt_state=rebuild(_B.unflatten(new_t, layout)),
+                ef=ef_stacked,
+            )
+
+        if self.overlap:
+            seg_sync = seg_sync_overlap
+            seg_sync_compressed = seg_sync_overlap_compressed
+            seg_opt = seg_opt_overlap
+
         def sm(f, in_specs, out_specs):
             return jax.jit(
                 jax.shard_map(
@@ -680,6 +773,10 @@ class LMSegments:
 
         import optax
 
+        from cs744_pytorch_distributed_tutorial_tpu.parallel import (
+            buckets as _B,
+            overlap as _OV,
+        )
         from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
             DATA_AXIS,
         )
@@ -713,6 +810,7 @@ class LMSegments:
             )
         self.trainer = trainer
         self.compress = trainer._compress
+        self.overlap = getattr(trainer, "_overlap", False)
         model, tx = trainer.model, trainer.tx
         data_size = trainer.data_size
         bucket_bytes = trainer._bucket_bytes
@@ -811,6 +909,86 @@ class LMSegments:
         def seg_opt(params, opt_state, synced):
             updates, new_opt = tx.update(synced, opt_state, params)
             return optax.apply_updates(params, updates), new_opt
+
+        # Overlapped-schedule segments — see CifarSegments for the
+        # bitwise argument (independent buckets: all-syncs-then-all-
+        # applies equals the fused interleaving).
+        ov_name = "int8_allreduce" if self.compress else "allreduce"
+
+        def ov_layout(tree):
+            return _OV.overlap_layout(
+                tree,
+                "allreduce",
+                data_size,
+                bucket_bytes,
+                compressed=self.compress,
+            )
+
+        def seg_sync_overlap(grads_stacked):
+            g = jax.tree.map(lambda a: a[0], grads_stacked)
+            layout = ov_layout(g)
+            bufs = _B.flatten_for_sync(g, layout)
+            synced = []
+            for k, buf in enumerate(bufs):
+                with jax.named_scope(
+                    f"graftscope/sync/overlap/{ov_name}/bucket{k:02d}"
+                ):
+                    synced.append(
+                        _OV.sync_bucket(buf, "allreduce", DATA_AXIS, data_size)
+                    )
+            return _B.unflatten(synced, layout)
+
+        def seg_sync_overlap_compressed(grads_stacked, ef_stacked):
+            g = jax.tree.map(lambda a: a[0], grads_stacked)
+            e = jax.tree.map(lambda a: a[0], ef_stacked)
+            layout = ov_layout(g)
+            g_bufs = _B.flatten_for_sync(g, layout)
+            e_bufs = _B.flatten_for_sync(e, layout)
+            synced, new_e = [], []
+            for k, (gbuf, ebuf) in enumerate(zip(g_bufs, e_bufs)):
+                with jax.named_scope(
+                    f"graftscope/sync/overlap/{ov_name}/bucket{k:02d}"
+                ):
+                    s, resid = _OV.sync_bucket_compressed(
+                        gbuf, ebuf, ov_name, DATA_AXIS, data_size
+                    )
+                synced.append(s)
+                new_e.append(resid)
+            ef_out = _B.unflatten(new_e, layout)
+            return (
+                _B.unflatten(synced, layout),
+                jax.tree.map(lambda a: a[None], ef_out),
+            )
+
+        def seg_opt_overlap(params, opt_state, synced):
+            trace, rebuild = _OV.split_momentum(opt_state)
+            layout = ov_layout(synced)
+            p_bufs = _B.flatten_for_sync(params, layout)
+            t_bufs = _B.flatten_for_sync(trace, layout)
+            s_bufs = _B.flatten_for_sync(synced, layout)
+            new_p, new_t = [], []
+            for k, (p, t, s) in enumerate(zip(p_bufs, t_bufs, s_bufs)):
+                with jax.named_scope(
+                    f"graftscope/optimizer/overlap/bucket{k:02d}"
+                ):
+                    pn, tn = _OV.apply_bucket(
+                        p,
+                        t,
+                        s,
+                        lr=cfg.learning_rate,
+                        momentum=cfg.momentum,
+                        weight_decay=cfg.weight_decay,
+                    )
+                new_p.append(pn)
+                new_t.append(tn)
+            return _B.unflatten(new_p, layout), rebuild(
+                _B.unflatten(new_t, layout)
+            )
+
+        if self.overlap:
+            seg_sync = seg_sync_overlap
+            seg_sync_compressed = seg_sync_overlap_compressed
+            seg_opt = seg_opt_overlap
 
         def sm(f, in_specs, out_specs):
             return jax.jit(
@@ -1047,6 +1225,7 @@ def profile_phases(
             trainer.axis_size,
             cfg.grad_compress,
             bucket_bytes=trainer._bucket_bytes,
+            overlap=segs.overlap,
         )
     )
     device_kind = jax.devices()[0].device_kind
@@ -1130,6 +1309,7 @@ def profile_lm_phases(
             dp_strategy,
             trainer.data_size,
             bucket_bytes=trainer._bucket_bytes,
+            overlap=segs.overlap,
         )
     )
     device_kind = jax.devices()[0].device_kind

@@ -68,28 +68,43 @@ _LAYOUT_CACHE: dict[tuple, BucketLayout] = {}
 
 
 def bucket_layout(
-    tree, bucket_bytes: int = DEFAULT_BUCKET_BYTES, rows: int = 0
+    tree,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    rows: int = 0,
+    reverse: bool = False,
 ) -> BucketLayout:
     """Deterministic greedy layout: walk leaves in tree-flatten order,
     appending each to the open bucket of its dtype; close the bucket when
     the next leaf would exceed ``bucket_bytes`` (a single oversized leaf
-    gets a bucket to itself). Memoized per structure/shape signature."""
+    gets a bucket to itself). Memoized per structure/shape signature.
+
+    ``reverse=True`` packs the greedy walk in REVERSED tree-flatten order
+    — the overlapped sync schedule's layout (``parallel/overlap.py``):
+    backward produces the LAST layers' gradients first, so bucket 0 holds
+    the tree's tail and its collective can dispatch while earlier layers
+    are still differentiating. ``slots`` stays indexed by the original
+    tree-flatten leaf order either way (only the bucket assignment
+    changes), so ``flatten_for_sync``/``unflatten`` are layout-agnostic.
+    """
     leaves, treedef = jax.tree.flatten(tree)
     sig = (
         treedef,
         tuple((tuple(l.shape), np.dtype(l.dtype).name) for l in leaves),
         int(bucket_bytes),
         int(rows),
+        bool(reverse),
     )
     cached = _LAYOUT_CACHE.get(sig)
     if cached is not None:
         return cached
 
-    slots: list[LeafSlot] = []
+    slots: list[LeafSlot | None] = [None] * len(leaves)
     bucket_fill: list[int] = []
     bucket_dtypes: list[str] = []
     open_by_dtype: dict[str, int] = {}
-    for leaf in leaves:
+    order = range(len(leaves) - 1, -1, -1) if reverse else range(len(leaves))
+    for i in order:
+        leaf = leaves[i]
         dt = np.dtype(leaf.dtype)
         size = int(math.prod(leaf.shape))
         cols = -(-size // rows) if rows else size
@@ -101,7 +116,7 @@ def bucket_layout(
             bucket_fill.append(0)
             bucket_dtypes.append(dt.name)
             open_by_dtype[dt.name] = b
-        slots.append(LeafSlot(b, bucket_fill[b], cols, tuple(leaf.shape), dt.name))
+        slots[i] = LeafSlot(b, bucket_fill[b], cols, tuple(leaf.shape), dt.name)
         bucket_fill[b] += cols
 
     layout = BucketLayout(
@@ -124,7 +139,7 @@ def flatten_for_sync(tree, layout: BucketLayout) -> list[jax.Array]:
             f"{layout.treedef}"
         )
     rows = layout.rows
-    parts: list[list[jax.Array]] = [[] for _ in layout.bucket_cols]
+    parts: list[list[tuple[int, jax.Array]]] = [[] for _ in layout.bucket_cols]
     for leaf, slot in zip(leaves, layout.slots):
         flat = jnp.ravel(leaf)
         if rows:
@@ -132,9 +147,16 @@ def flatten_for_sync(tree, layout: BucketLayout) -> list[jax.Array]:
             if pad:
                 flat = jnp.pad(flat, (0, pad))
             flat = flat.reshape(rows, slot.size)
-        parts[slot.bucket].append(flat)
+        parts[slot.bucket].append((slot.offset, flat))
     axis = 1 if rows else 0
-    return [jnp.concatenate(ps, axis=axis) for ps in parts]
+    # Concatenate by slot OFFSET, not tree-flatten order: a reverse-packed
+    # layout assigns in-bucket offsets in reversed leaf order.
+    return [
+        jnp.concatenate(
+            [f for _, f in sorted(ps, key=lambda t: t[0])], axis=axis
+        )
+        for ps in parts
+    ]
 
 
 def unflatten(bufs: list[jax.Array], layout: BucketLayout):
@@ -163,7 +185,12 @@ def tree_bytes(tree) -> tuple[int, int]:
 
 
 def _int8_padded_elems(
-    params, strategy: str, axis_size: int, bucket_bytes: int, quant_chunk: int
+    params,
+    strategy: str,
+    axis_size: int,
+    bucket_bytes: int,
+    quant_chunk: int,
+    reverse: bool = False,
 ) -> int:
     """Exact element count the int8 wire kernels move, padding included.
 
@@ -172,8 +199,10 @@ def _int8_padded_elems(
     n-way split with Q-aligned rows (ring form). The padding is real wire
     traffic (~5% on small models), so byte accounting that ignores it
     fails graftcheck's 1% cross-check against the traced jaxpr.
+    ``reverse`` selects the overlapped schedule's reverse-order layout,
+    whose bucket partition (and hence padding) can differ.
     """
-    layout = bucket_layout(params, bucket_bytes, rows=0)
+    layout = bucket_layout(params, bucket_bytes, rows=0, reverse=reverse)
     n = int(axis_size)
     total = 0
     for cols in layout.bucket_cols:
@@ -194,6 +223,7 @@ def sync_bytes_per_step(
     *,
     quant_chunk: int = 256,
     bucket_bytes: int | None = None,
+    reverse: bool = False,
 ) -> int:
     """Analytic mean gradient-sync payload bytes SENT per device per step.
 
@@ -235,7 +265,7 @@ def sync_bytes_per_step(
     if strategy in ("int8_allreduce", "int8_ring"):
         if bucket_bytes:
             elems = _int8_padded_elems(
-                params, strategy, n, bucket_bytes, quant_chunk
+                params, strategy, n, bucket_bytes, quant_chunk, reverse=reverse
             )
         payload = elems * (1.0 + 4.0 / quant_chunk)
         return int(ring_factor * payload)

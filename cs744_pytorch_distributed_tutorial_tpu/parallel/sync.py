@@ -387,6 +387,7 @@ def sync_wire_bytes(
     *,
     quant_chunk: int = QUANT_CHUNK,
     bucket_bytes: int | None = None,
+    overlap: bool = False,
 ) -> int:
     """Per-step gradient-sync payload bytes of the ACTIVE configuration.
 
@@ -397,7 +398,10 @@ def sync_wire_bytes(
     exactly what ``sync_grads_compressed`` does to the collectives. Pass
     the engine's ``bucket_bytes`` so the int8 paths count their padded
     payload exactly (graftcheck TA003 holds this number to within 1% of
-    the bytes derived from the traced jaxpr). The telemetry layer records
+    the bytes derived from the traced jaxpr). ``overlap=True`` selects
+    the overlapped schedule's reverse-order bucket layout
+    (``parallel/overlap.py``) — same float bytes, but the int8 padding
+    follows the reversed bucket partition. The telemetry layer records
     this number as ``grad_sync_bytes`` per step.
     """
     if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
@@ -410,6 +414,7 @@ def sync_wire_bytes(
         axis_size,
         quant_chunk=quant_chunk,
         bucket_bytes=bucket_bytes,
+        reverse=overlap,
     )
 
 
@@ -421,6 +426,7 @@ def sync_units(
     *,
     bucket_bytes: int | None = DEFAULT_BUCKET_BYTES,
     grad_compress: str = "none",
+    overlap: bool = False,
 ) -> int:
     """How many sync UNITS one pass over ``params`` issues collectives
     for: buckets where the strategy coalesces (``allreduce``/``ring``
@@ -428,13 +434,17 @@ def sync_units(
     everywhere else. This mirrors the routing in :func:`sync_grads`,
     :func:`sync_grads_compressed` and ``zero.Zero1SGD.apply`` exactly —
     it is the unit count :func:`expected_collective_schedule` scales by.
+    ``overlap=True`` counts the overlapped schedule's reverse-order
+    buckets (``parallel/overlap.py``: always bucketed, same collective
+    classes per unit, but the reversed greedy walk can partition the
+    tree into a different number of buckets).
     """
     leaves = len(jax.tree.leaves(params))
     if axis_size <= 1 or name == "none":
         return leaves
     if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
         layout = B.bucket_layout(
-            params, bucket_bytes or B.DEFAULT_BUCKET_BYTES, rows=0
+            params, bucket_bytes or B.DEFAULT_BUCKET_BYTES, rows=0, reverse=overlap
         )
         return len(layout.bucket_cols)
     if name in ("zero1", "fsdp"):
@@ -442,9 +452,14 @@ def sync_units(
             layout = B.bucket_layout(params, bucket_bytes, rows=axis_size)
             return len(layout.bucket_cols)
         return leaves
-    if bucket_bytes and name in _BUCKETED:
+    if (bucket_bytes or overlap) and name in _BUCKETED:
         rows = axis_size if name == "ring" else 0
-        layout = B.bucket_layout(params, bucket_bytes, rows=rows)
+        layout = B.bucket_layout(
+            params,
+            bucket_bytes or B.DEFAULT_BUCKET_BYTES,
+            rows=rows,
+            reverse=overlap,
+        )
         return len(layout.bucket_cols)
     return leaves
 

@@ -60,6 +60,7 @@ from cs744_pytorch_distributed_tutorial_tpu.obs.metrics import (
     Telemetry,
     tree_l2_norm,
 )
+from cs744_pytorch_distributed_tutorial_tpu.parallel import overlap as OV
 from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
     UNCHECKED_REPLICATION,
     get_sync,
@@ -300,6 +301,58 @@ class Trainer:
                     "leaves plus error-feedback state the kernel cannot carry)"
                 )
         self._compress_ring = cfg.sync in ("ring", "int8_ring")
+        if cfg.sync_overlap not in OV.OVERLAP_MODES:
+            raise ValueError(
+                f"unknown sync_overlap {cfg.sync_overlap!r}; choose from "
+                f"{OV.OVERLAP_MODES}"
+            )
+        self._overlap = cfg.sync_overlap != "off"
+        if self._overlap:
+            if self._zero1 or self._fsdp or cfg.fused_optimizer:
+                raise ValueError(
+                    f"sync_overlap={cfg.sync_overlap!r} replaces the "
+                    "tree-wide optimizer apply with per-bucket updates; "
+                    f"sync={cfg.sync!r} fused_optimizer={cfg.fused_optimizer} "
+                    "supply their own update and cannot combine (zero1/fsdp "
+                    "shard the very state the bucket apply must see whole)"
+                )
+            if cfg.accum_steps != 1:
+                raise ValueError(
+                    "sync_overlap overlaps ONE backward with its sync; "
+                    f"accum_steps={cfg.accum_steps} syncs per microbatch "
+                    "on a different schedule — use the fused path"
+                )
+            if (
+                cfg.optimizer != "sgd"
+                or cfg.lr_schedule != "constant"
+                or cfg.warmup_steps
+                or cfg.grad_clip_norm is not None
+            ):
+                raise ValueError(
+                    "sync_overlap applies the reference's fixed-LR "
+                    "SGD(momentum) per bucket (parallel/overlap.py); "
+                    f"optimizer={cfg.optimizer!r}/lr_schedule="
+                    f"{cfg.lr_schedule!r}/warmup_steps={cfg.warmup_steps}/"
+                    f"grad_clip_norm={cfg.grad_clip_norm} need the tree-wide "
+                    "optax path (a global clip or schedule state cannot be "
+                    "applied bucket-locally)"
+                )
+            if cfg.sync_overlap == "bucket":
+                if self._compress or cfg.sync not in ("allreduce", "ring"):
+                    raise ValueError(
+                        "sync_overlap='bucket' overlaps the float bucketed "
+                        "wire: requires sync in ('allreduce', 'ring') and "
+                        f"grad_compress='none' (got sync={cfg.sync!r}, "
+                        f"grad_compress={cfg.grad_compress!r}; for the "
+                        "quantized wire use sync_overlap='bucket+int8')"
+                    )
+            elif not self._compress:
+                raise ValueError(
+                    "sync_overlap='bucket+int8' overlaps the int8+EF "
+                    "compressed wire: requires grad_compress='int8' or an "
+                    f"int8_* sync strategy (got sync={cfg.sync!r}, "
+                    f"grad_compress={cfg.grad_compress!r})"
+                )
         # The compressed path's all_to_all/all_gather/ppermute outputs are
         # replication-unprovable, like the explicit manual strategies.
         self._check_vma = (
@@ -435,7 +488,7 @@ class Trainer:
                 (local_loss, new_stats), grads = jax.value_and_grad(
                     local_loss_fn, has_aux=True
                 )(params_local)
-                if not self._compress:
+                if not self._compress and not self._overlap:
                     grads = sync_grads(
                         grads,
                         explicit_sync,
@@ -443,6 +496,10 @@ class Trainer:
                         axis_size,
                         bucket_bytes=self._bucket_bytes,
                     )
+                # Overlapped sync happens in local_train_step: each
+                # reverse-order bucket's collective AND its slice of the
+                # SGD update chain off only that bucket's gradients, so
+                # grads must leave here LOCAL (parallel/overlap.py).
                 # Compressed sync happens ONCE per step, after gradient
                 # accumulation (local_train_step): quantizing each
                 # microbatch separately would decouple the error-feedback
@@ -514,7 +571,7 @@ class Trainer:
                 local_loss = ll_sum / accum
 
             new_ef = state.ef
-            if self._compress:
+            if self._compress and not self._overlap:
                 # Quantized all-reduce of the ACCUMULATED local gradient,
                 # with this device's untransmitted residual added before
                 # quantization and the new residual carried to next step.
@@ -532,7 +589,43 @@ class Trainer:
                 )
                 new_ef = jax.tree.map(lambda a: a[None], ef_out)
 
-            if self._zero1 or self._fsdp or cfg.fused_optimizer:
+            if self._overlap:
+                # Overlapped bucket pipeline: per-bucket collective +
+                # per-bucket SGD apply over reverse-order buckets — no
+                # tree-wide barrier between backward, sync, and apply, so
+                # XLA schedules bucket k's collective under the remaining
+                # backward and bucket k-1's optimizer math. Bitwise-equal
+                # to the fused sync+optax chain for allreduce/ring
+                # (tests/test_sync_parity.py); int8 holds the trajectory
+                # bar. grads comes back as the synced mean (telemetry).
+                ef_local = (
+                    jax.tree.map(lambda a: a[0], state.ef)
+                    if self._compress
+                    else None
+                )
+                trace, rebuild = OV.split_momentum(state.opt_state)
+                wire = (
+                    ("int8_ring" if self._compress_ring else "int8_allreduce")
+                    if self._compress
+                    else cfg.sync
+                )
+                new_params, new_trace, grads, ef_out = OV.overlapped_sync_apply(
+                    grads,
+                    state.params,
+                    trace,
+                    name=wire,
+                    axis_name=DATA_AXIS,
+                    axis_size=axis_size,
+                    lr=cfg.learning_rate,
+                    momentum=cfg.momentum,
+                    weight_decay=cfg.weight_decay,
+                    bucket_bytes=self._bucket_bytes,
+                    ef=ef_local,
+                )
+                new_opt = rebuild(new_trace)
+                if self._compress:
+                    new_ef = jax.tree.map(lambda a: a[None], ef_out)
+            elif self._zero1 or self._fsdp or cfg.fused_optimizer:
                 # Under zero1 the grads are still LOCAL here: Zero1SGD
                 # fuses the averaging (reduce-scatter) into its sharded
                 # update and returns replicated params + the local
@@ -790,6 +883,7 @@ class Trainer:
             self.axis_size,
             cfg.grad_compress,
             bucket_bytes=self._bucket_bytes,
+            overlap=self._overlap,
         )
         sched = make_schedule(cfg)
         lr_at = (
@@ -1297,6 +1391,7 @@ def make_trace_entry(**overrides):
             trainer.axis_size,
             bucket_bytes=trainer._bucket_bytes,
             grad_compress=cfg.grad_compress,
+            overlap=trainer._overlap,
         )
         schedule = expected_collective_schedule(
             effective,
@@ -1311,6 +1406,7 @@ def make_trace_entry(**overrides):
         trainer.axis_size,
         cfg.grad_compress,
         bucket_bytes=trainer._bucket_bytes,
+        overlap=trainer._overlap,
     )
     return TracedStep(
         name="cifar",
@@ -1323,12 +1419,23 @@ def make_trace_entry(**overrides):
         expected_schedule=schedule,
         expected_wire_bytes=float(wire_bytes),
         check_donation=True,
-        detail={"model": cfg.model, "accum_steps": cfg.accum_steps},
+        detail={
+            "model": cfg.model,
+            "accum_steps": cfg.accum_steps,
+            "sync_overlap": cfg.sync_overlap,
+        },
     )
 
 
 def _cifar_int8_entry():
     return make_trace_entry(sync="int8_allreduce")
+
+
+def _cifar_overlap_entry():
+    # The overlapped schedule's TA003 contract: same collective classes
+    # and byte counts as fused bucketed allreduce, placed per reverse-
+    # order bucket (sync_units(overlap=True) counts that layout).
+    return make_trace_entry(sync_overlap="bucket")
 
 
 def _register_trace_entries() -> None:
@@ -1338,6 +1445,9 @@ def _register_trace_entries() -> None:
 
     register_entrypoint("cifar", make_trace_entry, tags=("cifar",))
     register_entrypoint("cifar-int8", _cifar_int8_entry, tags=("cifar", "int8"))
+    register_entrypoint(
+        "cifar-overlap", _cifar_overlap_entry, tags=("cifar", "overlap")
+    )
 
 
 _register_trace_entries()

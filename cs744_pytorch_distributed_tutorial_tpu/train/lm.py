@@ -176,6 +176,14 @@ class LMConfig:
     # Bucket size (MiB) for the compressed sync's coalesced buffers;
     # 0 falls back to the default bucket size.
     sync_bucket_mb: float = 4.0
+    # Overlapped gradient sync (parallel/overlap.py): reverse-layer-order
+    # buckets, per-bucket collective + per-bucket SGD apply — DDP's
+    # reducer schedule as dataflow. "bucket" overlaps the float DP pmean;
+    # "bucket+int8" overlaps the int8+EF wire (grad_compress="int8").
+    # Pure-DP layouts only (same restriction as grad_compress) and the
+    # fixed-LR SGD recipe (optimizer="sgd", constant lr, no warmup/clip,
+    # accum_steps=1).
+    sync_overlap: str = "off"  # "off" | "bucket" | "bucket+int8"
 
     # Rematerialization: recompute block activations in backward instead
     # of storing them (jax.checkpoint) — identical numerics, O(layers)
@@ -404,6 +412,61 @@ class LMTrainer:
                 f"sync_bucket_mb must be >= 0, got {cfg.sync_bucket_mb}"
             )
         self._bucket_bytes = int(cfg.sync_bucket_mb * 2**20)
+        from cs744_pytorch_distributed_tutorial_tpu.parallel.overlap import (
+            OVERLAP_MODES,
+        )
+
+        if cfg.sync_overlap not in OVERLAP_MODES:
+            raise ValueError(
+                f"unknown sync_overlap {cfg.sync_overlap!r}; choose from "
+                f"{OVERLAP_MODES}"
+            )
+        self._overlap = cfg.sync_overlap != "off"
+        if self._overlap:
+            if (
+                self.seq_size > 1
+                or self.tensor_size > 1
+                or cfg.zero1
+                or cfg.fsdp
+                or self.expert_parallel
+            ):
+                raise ValueError(
+                    "sync_overlap requires a pure data-parallel layout "
+                    "(tensor_parallel == seq_parallel == 1, no zero1/fsdp, "
+                    "no expert parallelism): the per-bucket schedule models "
+                    "the plain data-axis gradient pmean, not psum_scatter "
+                    "chunks or locally-sharded grads"
+                )
+            if cfg.accum_steps != 1:
+                raise ValueError(
+                    "sync_overlap requires accum_steps=1: the per-bucket "
+                    "apply consumes each bucket as backward produces it, "
+                    "which an accumulation scan would serialize anyway"
+                )
+            if (
+                cfg.optimizer != "sgd"
+                or cfg.lr_schedule != "constant"
+                or cfg.warmup_steps
+                or cfg.grad_clip_norm is not None
+            ):
+                raise ValueError(
+                    "sync_overlap requires the reference's fixed-LR SGD "
+                    "recipe (optimizer='sgd', lr_schedule='constant', "
+                    "warmup_steps=0, grad_clip_norm=None): the per-bucket "
+                    "apply is the flat torch-SGD update, and a clip or "
+                    "schedule would reintroduce the tree-wide barrier the "
+                    "overlap removes"
+                )
+            if cfg.sync_overlap == "bucket" and self._compress:
+                raise ValueError(
+                    "sync_overlap='bucket' overlaps the float wire; with "
+                    "grad_compress='int8' use sync_overlap='bucket+int8'"
+                )
+            if cfg.sync_overlap == "bucket+int8" and not self._compress:
+                raise ValueError(
+                    "sync_overlap='bucket+int8' overlaps the int8+EF wire; "
+                    "set grad_compress='int8'"
+                )
         dtype = resolve_dtype(cfg.compute_dtype)
         flash_interpret = interpret_kernels(self.mesh)
         self._flash_interpret = flash_interpret
@@ -810,9 +873,20 @@ class LMTrainer:
         accum = self.cfg.accum_steps
         compress = self._compress
         bucket_bytes = self._bucket_bytes
+        overlap = self._overlap
         if compress:
             from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
                 sync_grads_compressed,
+            )
+        if overlap:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel import (
+                overlap as OV,
+            )
+
+            overlap_hp = dict(
+                lr=self.cfg.learning_rate,
+                momentum=self.cfg.momentum,
+                weight_decay=self.cfg.weight_decay,
             )
 
         fused_xent = self.cfg.fused_xent
@@ -974,6 +1048,33 @@ class LMTrainer:
                     params, opt_state = zero1_opt.apply(
                         params, opt_state, grads, orig_specs
                     )
+            elif overlap:
+                # Overlapped schedule (parallel/overlap.py): per-bucket
+                # sync + per-bucket torch-SGD apply over reverse-order
+                # buckets, one fused program with no tree-wide barrier.
+                # Pure DP + fixed-LR SGD (validated in __init__), so the
+                # data-axis mean is the whole sync and the flat update is
+                # bitwise the optax chain. grads rebind to the synced
+                # mean so the telemetry norms below read the same tree
+                # the fused path logs.
+                ef_local = (
+                    jax.tree.map(lambda a: a[0], ef) if compress else None
+                )
+                trace, rebuild = OV.split_momentum(opt_state)
+                params, new_trace, grads, ef_out = OV.overlapped_sync_apply(
+                    grads,
+                    params,
+                    trace,
+                    name="allreduce",
+                    axis_name=DATA_AXIS,
+                    axis_size=data_size,
+                    bucket_bytes=bucket_bytes,
+                    ef=ef_local,
+                    **overlap_hp,
+                )
+                opt_state = rebuild(new_trace)
+                if compress:
+                    ef = jax.tree.map(lambda a: a[None], ef_out)
             elif compress:
                 # Quantized bucket all-reduce of the accumulated local
                 # gradient with this device's error-feedback residual
@@ -1223,6 +1324,7 @@ class LMTrainer:
             dp_strategy,
             self.data_size,
             bucket_bytes=self._bucket_bytes,
+            overlap=self._overlap,
         )
         sched = make_schedule(cfg)
         lr_at = (
@@ -1474,13 +1576,19 @@ def make_lm_trace_entry(**overrides):
     else:
         dp_strategy = "allreduce"
     # The LM sync is per-LEAF for every uncompressed path (sync_grad /
-    # Zero1Adam map over leaves); only the int8 path buckets.
+    # Zero1Adam map over leaves); the int8 path and the overlapped
+    # schedule bucket (reverse-order buckets under overlap).
     units = sync_units(
         params,
         dp_strategy,
         trainer.data_size,
-        bucket_bytes=trainer._bucket_bytes if trainer._compress else None,
+        bucket_bytes=(
+            trainer._bucket_bytes
+            if (trainer._compress or trainer._overlap)
+            else None
+        ),
         grad_compress=cfg.grad_compress,
+        overlap=trainer._overlap,
     )
     schedule = expected_collective_schedule(
         dp_strategy,
@@ -1493,6 +1601,7 @@ def make_lm_trace_entry(**overrides):
         dp_strategy,
         trainer.data_size,
         bucket_bytes=trainer._bucket_bytes,
+        overlap=trainer._overlap,
     )
     return TracedStep(
         name="lm",
@@ -1509,8 +1618,15 @@ def make_lm_trace_entry(**overrides):
             "layers": cfg.num_layers,
             "d_model": cfg.d_model,
             "dp": trainer.data_size,
+            "sync_overlap": cfg.sync_overlap,
         },
     )
+
+
+def _lm_overlap_entry():
+    # The overlapped schedule needs the fixed-LR SGD recipe (LM defaults
+    # to adamw).
+    return make_lm_trace_entry(optimizer="sgd", sync_overlap="bucket")
 
 
 def _register_lm_trace_entries() -> None:
@@ -1519,6 +1635,9 @@ def _register_lm_trace_entries() -> None:
     )
 
     register_entrypoint("lm", make_lm_trace_entry, tags=("lm",))
+    register_entrypoint(
+        "lm-overlap", _lm_overlap_entry, tags=("lm", "overlap")
+    )
 
 
 _register_lm_trace_entries()

@@ -469,6 +469,68 @@ def test_gl008_clean_used_and_exempt():
     )
 
 
+# ---------------------------------------------------------------- GL009
+def test_gl009_block_until_ready_in_step_loop():
+    hits = run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s)
+
+        def fit(state, steps):
+            for _ in range(steps):
+                state = step(state)
+                jax.block_until_ready(state)
+            return state
+        """,
+        "GL009",
+    )
+    assert len(hits) == 1 and "block_until_ready" in hits[0].message
+
+
+def test_gl009_method_form_and_device_get():
+    hits = run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s)
+
+        def fit(state, steps):
+            for _ in range(steps):
+                state = step(state)
+                state.block_until_ready()
+                host = jax.device_get(state)
+            return state
+        """,
+        "GL009",
+    )
+    assert len(hits) == 2
+
+
+def test_gl009_clean_cadence_gated_and_no_jit():
+    # A wait behind a cadence gate is the sanctioned telemetry pattern,
+    # and a loop that drives no known jitted callable is not a step loop.
+    assert not run(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s)
+
+        def fit(state, steps):
+            for i in range(steps):
+                state = step(state)
+                if i % 100 == 0:
+                    jax.block_until_ready(state)
+            return state
+
+        def warm(xs):
+            for x in xs:
+                jax.block_until_ready(x)
+        """,
+        "GL009",
+    )
+
+
 # ---------------------------------------------------------- suppressions
 def test_trailing_suppression_silences_same_line():
     src = textwrap.dedent(

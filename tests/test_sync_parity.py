@@ -105,6 +105,100 @@ def test_sync_actually_replicates_params(results):
         np.testing.assert_allclose(g, r, rtol=1e-5, atol=1e-6)
 
 
+# ------------------------------------------------------- overlapped schedule
+def _run_steps(mesh, batch, steps, **cfg_kw):
+    """Final params + per-step losses for a tiny_cnn run on 4 devices."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+
+    cfg = TrainConfig(
+        model="tiny_cnn", num_devices=4, global_batch_size=16, seed=5000,
+        **cfg_kw,
+    )
+    tr = Trainer(cfg, mesh=mesh)
+    state = tr.init()
+    gx, gy = shard_global_batch(mesh, *batch)
+    key = jax.random.key(cfg.seed)
+    losses = []
+    for _ in range(steps):
+        state, metrics = tr.train_step(state, gx, gy, key)
+        losses.append(float(metrics["loss"]))
+    return jax.tree.map(np.asarray, jax.device_get(state.params)), losses
+
+
+@pytest.mark.parametrize("strategy", ["allreduce", "ring"])
+def test_overlap_bitwise_vs_fused(mesh4, batch, strategy):
+    """The overlapped bucket schedule (--sync-overlap bucket) reorders
+    WHEN each bucket syncs and applies, not WHAT is computed: for the
+    float wires the reverse-bucket mean and per-bucket SGD apply are the
+    same f32 operations on the same operands, so parity is bitwise —
+    any drift means the schedule changed the math."""
+    fused_p, fused_l = _run_steps(mesh4, batch, 3, sync=strategy)
+    ov_p, ov_l = _run_steps(
+        mesh4, batch, 3, sync=strategy, sync_overlap="bucket"
+    )
+    assert fused_l == ov_l
+    for r, g in zip(jax.tree.leaves(fused_p), jax.tree.leaves(ov_p)):
+        np.testing.assert_array_equal(g, r)
+
+
+@pytest.mark.slow
+def test_overlap_int8_ef_trajectory(mesh4):
+    """int8+EF overlap is NOT bitwise vs fused int8 — the reverse bucket
+    layout regroups the quantization chunks — but error feedback keeps
+    the trajectories together: over 50 steps the mean per-step relative
+    loss gap stays under 1% (the compression suite's tolerance class;
+    measured 0.66%). The mean is the stable statistic — single-step
+    losses on this chaotic repeated-batch config oscillate ~10%, so a
+    final-step bar would gate on noise, not on the schedule."""
+    from conftest import run_tiny_dp4_steps
+
+    fused_l, _, _ = run_tiny_dp4_steps(
+        "allreduce", mesh4, steps=50, cfg_overrides={"grad_compress": "int8"}
+    )
+    ov_l, _, _ = run_tiny_dp4_steps(
+        "allreduce", mesh4, steps=50,
+        cfg_overrides={
+            "grad_compress": "int8", "sync_overlap": "bucket+int8",
+        },
+    )
+    rels = [abs(a - b) / max(abs(a), 1.0) for a, b in zip(fused_l, ov_l)]
+    assert sum(rels) / len(rels) <= 0.01, (max(rels), sum(rels) / len(rels))
+    assert ov_l[-1] < ov_l[0]  # and it actually trained
+
+
+def test_overlap_int8_short_run_stays_close(mesh4):
+    """Fast (tier-1) version of the int8 overlap check: 8 steps, 2% —
+    the same bar as the fused int8-vs-f32 short-run test (measured
+    final-loss gap: 6e-5)."""
+    from conftest import run_tiny_dp4_steps
+
+    fused_l, _, _ = run_tiny_dp4_steps(
+        "allreduce", mesh4, steps=8, cfg_overrides={"grad_compress": "int8"}
+    )
+    ov_l, _, _ = run_tiny_dp4_steps(
+        "allreduce", mesh4, steps=8,
+        cfg_overrides={
+            "grad_compress": "int8", "sync_overlap": "bucket+int8",
+        },
+    )
+    assert ov_l[-1] == pytest.approx(fused_l[-1], rel=0.02)
+
+
+@pytest.mark.parametrize("strategy", ["zero1", "fsdp"])
+def test_overlap_rejects_sharded_optimizer(mesh4, strategy):
+    # Sharded-optimizer strategies interleave sync with their own
+    # gather/scatter schedule — per-bucket apply is not bitwise-sound
+    # there, so the engine must refuse rather than silently drift.
+    cfg = TrainConfig(
+        model="tiny_cnn", sync=strategy, sync_overlap="bucket",
+        num_devices=4, global_batch_size=16,
+    )
+    with pytest.raises(ValueError, match="sync_overlap"):
+        Trainer(cfg, mesh=mesh4)
+
+
 def test_none_requires_single_device():
     mesh = make_mesh({"data": 4}, devices=jax.devices()[:4])
     cfg = TrainConfig(model="tiny_cnn", sync="none", num_devices=4,

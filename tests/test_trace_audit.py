@@ -106,6 +106,63 @@ def test_ta003_cifar_schedule_matches_contract(sync, devices):
     )
 
 
+OVERLAP_CONFIGS = [
+    ("allreduce", "bucket"),
+    ("ring", "bucket"),
+    ("int8_allreduce", "bucket+int8"),
+]
+
+
+@pytest.mark.parametrize("sync,overlap", OVERLAP_CONFIGS)
+def test_ta003_overlapped_schedule_matches_contract(sync, overlap, devices):
+    """The overlapped bucket schedule (--sync-overlap) keeps TA003's
+    contract byte-exact: the same collective classes and wire bytes as
+    the fused bucketed wire, just placed per reverse-order bucket
+    (sync_units/sync_wire_bytes count the reverse layout when
+    overlap=True)."""
+    from cs744_pytorch_distributed_tutorial_tpu.train.engine import (
+        make_trace_entry,
+    )
+
+    step = make_trace_entry(sync=sync, sync_overlap=overlap)
+    closed = jax.make_jaxpr(step.fn)(*step.args)
+    colls = jaxpr_utils.collect_collectives(closed, step.axis_sizes)
+    counts = jaxpr_utils.schedule_counts(colls)
+    assert step.expected_schedule is not None
+    expected = {k: v for k, v in step.expected_schedule.items() if v}
+    assert counts == expected, f"{sync}+{overlap}: {counts} != {expected}"
+
+    wire = jaxpr_utils.total_wire_bytes(colls)
+    tol = max(0.01 * step.expected_wire_bytes, 512.0)
+    assert abs(wire - step.expected_wire_bytes) <= tol, (
+        f"{sync}+{overlap}: jaxpr wire {wire} vs accounting "
+        f"{step.expected_wire_bytes}"
+    )
+    if overlap == "bucket":
+        # Float wires: overlap changes WHERE the collectives sit, not
+        # how many bytes move — fused and overlapped accounting agree
+        # exactly. (int8 exempt: reverse bucketing regroups the
+        # quantization chunks, shifting per-bucket padding slightly.)
+        fused = make_trace_entry(sync=sync)
+        assert step.expected_wire_bytes == fused.expected_wire_bytes
+
+
+def test_ta003_lm_overlapped_schedule(devices):
+    from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
+        make_lm_trace_entry,
+    )
+
+    step = make_lm_trace_entry(optimizer="sgd", sync_overlap="bucket")
+    closed = jax.make_jaxpr(step.fn)(*step.args)
+    colls = jaxpr_utils.collect_collectives(closed, step.axis_sizes)
+    counts = jaxpr_utils.schedule_counts(colls)
+    expected = {k: v for k, v in step.expected_schedule.items() if v}
+    assert counts == expected, f"lm-overlap: {counts} != {expected}"
+    wire = jaxpr_utils.total_wire_bytes(colls)
+    tol = max(0.01 * step.expected_wire_bytes, 512.0)
+    assert abs(wire - step.expected_wire_bytes) <= tol
+
+
 def test_ta003_int8_wire_beats_f32(devices):
     from cs744_pytorch_distributed_tutorial_tpu.train.engine import (
         make_trace_entry,
@@ -374,19 +431,21 @@ def test_registry_unknown_name_lists_known():
 def test_builtin_entrypoints_load():
     load_builtin_entrypoints()
     names = {e.name for e in get_entrypoints()}
-    assert {"cifar", "cifar-int8", "lm"} <= names
+    assert {"cifar", "cifar-int8", "cifar-overlap", "lm", "lm-overlap"} <= names
 
 
 def test_clean_repo_audits_green(devices):
     """The acceptance gate: every registered entrypoint audits clean."""
     load_builtin_entrypoints()
-    entries = get_entrypoints(["cifar", "cifar-int8", "lm"])
+    entries = get_entrypoints(
+        ["cifar", "cifar-int8", "cifar-overlap", "lm", "lm-overlap"]
+    )
     findings, _suppressed, summaries, _sources, errors = run_audits(
         entries, ALL_RULES
     )
     assert errors == []
     assert findings == []
-    assert len(summaries) == 3
+    assert len(summaries) == 5
     for s in summaries:
         assert s["donation"]["donated"] == s["donation"]["aliased"]
 
