@@ -373,11 +373,30 @@ def _parse_args() -> argparse.Namespace:
         help="also append the result records to METRICS_DIR/metrics.jsonl "
         "(the training-telemetry stream format)",
     )
+    p.add_argument(
+        "--serve",
+        nargs=argparse.REMAINDER,
+        default=None,
+        help="delegate to the continuous-batching serving benchmark "
+        "(serve_cli, docs/serving.md): every argument AFTER --serve "
+        "passes through, e.g. bench.py --serve --requests 32 --gate. "
+        "A --metrics-dir given before --serve is forwarded.",
+    )
     return p.parse_args()
 
 
 def main() -> None:
     args = _parse_args()
+    if args.serve is not None:
+        from cs744_pytorch_distributed_tutorial_tpu.serve_cli import (
+            main as serve_main,
+        )
+
+        argv = list(args.serve)
+        if args.metrics_dir and "--metrics-dir" not in argv:
+            argv += ["--metrics-dir", args.metrics_dir]
+        serve_main(argv)
+        return
     sink = _make_sink(args.metrics_dir)
     try:
         if args.phase_breakdown:

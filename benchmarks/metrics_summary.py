@@ -84,6 +84,19 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
                           "sync_exposed_ms_fused", "sync_exposed_ms_overlap",
                           "parity_ok")
             }
+    # Serving rows (serve/loadgen.py): one row per engine label
+    # ("continuous" / "batch"), latest serve_summary record wins.
+    serve: dict[str, dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") == "serve_summary" and isinstance(
+            r.get("engine"), str
+        ):
+            serve[r["engine"]] = {
+                k: r.get(k)
+                for k in ("requests", "ttft_p50_ms", "ttft_p99_ms",
+                          "tokens_per_sec", "page_high_water",
+                          "slot_occupancy", "preemptions")
+            }
     return {
         "records": len(records),
         "step_records": len(steps),
@@ -99,6 +112,7 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "phases": phases,
         "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
         "sync_compare": sync_compare,
+        "serve": serve,
     }
 
 
@@ -140,6 +154,16 @@ def main(argv: list[str] | None = None) -> int:
         ))
     if summary["sync_exposed_ms"] is not None:
         rows.append(("sync exposed (ms)", summary["sync_exposed_ms"]))
+    for label, row in summary["serve"].items():
+        occ = row.get("slot_occupancy")
+        rows.append((
+            f"serve {label}",
+            f"{_fmt(row['requests'])} reqs, TTFT p50/p99 "
+            f"{_fmt(row['ttft_p50_ms'])}/{_fmt(row['ttft_p99_ms'])} ms, "
+            f"{_fmt(row['tokens_per_sec'])} tok/s, pages hw "
+            f"{_fmt(row.get('page_high_water'))}, occupancy "
+            f"{_fmt(round(occ, 3) if isinstance(occ, float) else occ)}",
+        ))
     for wire, row in summary["sync_compare"].items():
         rows.append((
             f"overlap {wire}",

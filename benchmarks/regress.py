@@ -101,6 +101,26 @@ def sync_exposed_values(records: list[dict[str, Any]]) -> list[float]:
     return vals
 
 
+def generic_budgets(records: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Generic absolute gates armed by baseline records of the form
+    ``{"metric": NAME, "budget": V, "direction": "max"|"min"}`` (the
+    checked-in ``benchmarks/serve_smoke_budget.json`` idiom). Direction
+    "max" (default) means the current value must stay <= budget (a
+    latency ceiling, e.g. serve p99 TTFT); "min" means >= budget (a
+    throughput floor). Last record per metric wins."""
+    budgets: dict[str, dict[str, Any]] = {}
+    for r in records:
+        if isinstance(r.get("metric"), str) and isinstance(
+            r.get("budget"), (int, float)
+        ):
+            budgets[r["metric"]] = {
+                "metric": r["metric"],
+                "budget": float(r["budget"]),
+                "direction": r.get("direction", "max"),
+            }
+    return list(budgets.values())
+
+
 def sync_exposed_budget(records: list[dict[str, Any]]) -> float | None:
     """Absolute sync_exposed_ms ceiling carried by the baseline side.
 
@@ -189,6 +209,27 @@ def evaluate(
         )
         if not verdict["sync_budget_ok"]:
             code = REGRESSION
+
+    checks = []
+    for bgt in generic_budgets(baseline_records):
+        vals = metric_values(current_records, bgt["metric"])
+        if not vals:
+            verdict["error"] = (
+                f"budget armed for metric {bgt['metric']!r} but the "
+                "current stream has no values for it"
+            )
+            return MISSING, verdict
+        cur_v = vals[-1]
+        ok = (
+            cur_v >= bgt["budget"]
+            if bgt["direction"] == "min"
+            else cur_v <= bgt["budget"]
+        )
+        checks.append({**bgt, "current": cur_v, "ok": ok})
+        if not ok:
+            code = REGRESSION
+    if checks:
+        verdict["budgets"] = checks
     return code, verdict
 
 
@@ -266,6 +307,13 @@ def main(argv: list[str] | None = None) -> int:
                 f"sync_exposed_ms budget: current "
                 f"{verdict['sync_exposed_current_ms']:.3f} vs budget "
                 f"{verdict['sync_exposed_budget_ms']:.3f}"
+            )
+        for bgt in verdict.get("budgets", []):
+            cmp_ = ">=" if bgt["direction"] == "min" else "<="
+            print(
+                f"regress [{'PASS' if bgt['ok'] else 'FAIL'}] "
+                f"{bgt['metric']} budget: current {bgt['current']:.3f} "
+                f"{cmp_} {bgt['budget']:.3f}"
             )
     return code
 

@@ -118,7 +118,9 @@ def load_builtin_entrypoints() -> None:
     coverage). Registration is re-run explicitly — not left to import
     side effects — so the call is idempotent even if something cleared
     the registry after the modules were first imported."""
+    from cs744_pytorch_distributed_tutorial_tpu.serve import engine as serve_engine
     from cs744_pytorch_distributed_tutorial_tpu.train import engine, lm
 
     engine._register_trace_entries()
     lm._register_lm_trace_entries()
+    serve_engine._register_serve_trace_entries()

@@ -89,9 +89,14 @@ def apply_rope(
         raise ValueError(f"RoPE needs an even head_dim, got {d}")
     half = d // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
-    sin = jnp.sin(angles)[None, :, None, :]
-    cos = jnp.cos(angles)[None, :, None, :]
+    # positions is [T] (shared across the batch) or [B, T] (per-slot
+    # depths on the paged-decode serve path — each slot rotates by its
+    # own global position).
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs
+    sin = jnp.sin(angles)[..., None, :]
+    cos = jnp.cos(angles)[..., None, :]
+    if angles.ndim == 2:  # [T, half] -> broadcast over batch as before
+        sin, cos = sin[None], cos[None]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
@@ -175,6 +180,13 @@ class Attention(nn.Module):
     # Incompatible with a tensor axis: the row-parallel attn_out bias
     # would be psum-summed tensor_axis_size times.
     attn_bias: bool = False
+    # Paged KV pool (mode="paged_decode", serve/): per-layer
+    # [num_pages, page_size, Hkv, D] pools in the "pages" collection,
+    # indexed by a per-slot page table — memory scales with live tokens
+    # across the whole engine, not B x max_seq_len. Both must be set to
+    # use the paged mode.
+    page_size: int | None = None
+    num_pages: int | None = None
 
     @nn.compact
     def __call__(
@@ -183,14 +195,16 @@ class Attention(nn.Module):
         *,
         mode: str = "train",
         decode_pos: jnp.ndarray | None = None,
+        page_table: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         if self.impl not in ATTENTION_IMPLS:
             raise ValueError(
                 f"unknown attention impl {self.impl!r}; choose from {ATTENTION_IMPLS}"
             )
-        if mode not in ("train", "prefill", "decode"):
+        if mode not in ("train", "prefill", "decode", "paged_decode"):
             raise ValueError(
-                f"unknown mode {mode!r}; choose from ('train', 'prefill', 'decode')"
+                f"unknown mode {mode!r}; choose from "
+                "('train', 'prefill', 'decode', 'paged_decode')"
             )
         b, t, d_model = x.shape
         if d_model % self.num_heads:
@@ -248,20 +262,25 @@ class Attention(nn.Module):
         if self.rope:
             # GLOBAL positions of this block's tokens: the shard offset
             # under sequence sharding, the cache position when decoding.
-            if mode == "decode":
+            if mode in ("decode", "paged_decode"):
                 if decode_pos is None:
-                    raise ValueError("mode='decode' needs decode_pos")
+                    raise ValueError(f"mode={mode!r} needs decode_pos")
                 offset = decode_pos
             elif self.seq_axis is not None and self.seq_axis_size > 1:
                 offset = lax.axis_index(self.seq_axis) * t
             else:
                 offset = 0
-            positions = offset + jnp.arange(t)
+            if jnp.ndim(offset):
+                # Per-slot depths (paged decode): [B] offsets -> [B, t]
+                # positions, each row rotating by its own depth.
+                positions = jnp.asarray(offset)[:, None] + jnp.arange(t)
+            else:
+                positions = offset + jnp.arange(t)
             q = apply_rope(q, positions, self.rope_base)
             k = apply_rope(k, positions, self.rope_base)
 
         decode_step = False
-        if mode != "train":
+        if mode in ("prefill", "decode"):
             # Cached prefill/decode (infer/generate.py): the cache holds
             # the FULL sequence, so the sequence axis must be unsharded
             # (generation runs outside shard_map; data parallelism comes
@@ -345,6 +364,91 @@ class Attention(nn.Module):
                 # both shapes).
                 write_cache(decode_pos)
                 decode_step = True
+        elif mode == "paged_decode":
+            # Continuous-batching serve path (serve/): KV lives in a
+            # POOL of fixed-size pages shared by every slot —
+            # [num_pages, page_size, Hkv, D] per layer in the "pages"
+            # collection — and each slot's pages are listed (in sequence
+            # order) by its ``page_table`` row. Pool memory scales with
+            # LIVE tokens across the engine instead of B x max_seq_len,
+            # and a retired slot's pages recycle immediately. The new
+            # token's K/V scatters into (page_table[b, pos//page],
+            # pos%page); attention gathers the slot's pages into the
+            # dense per-slot view and runs the exact decode_attention
+            # path, so paged decode is bitwise-identical to the dense
+            # cache (tests/test_serve.py).
+            if self.seq_axis is not None and self.seq_axis_size > 1:
+                raise ValueError(
+                    "paged decode requires an unsharded sequence axis; "
+                    f"got seq_axis={self.seq_axis!r} "
+                    f"(size {self.seq_axis_size})"
+                )
+            if self.page_size is None or self.num_pages is None:
+                raise ValueError(
+                    "mode='paged_decode' needs page_size and num_pages "
+                    "(the paged KV pool geometry; see serve/engine.py)"
+                )
+            if decode_pos is None or page_table is None:
+                raise ValueError(
+                    "mode='paged_decode' needs decode_pos (per-slot "
+                    "depths, [B]) and page_table ([B, P] page indices)"
+                )
+            if t != 1:
+                raise ValueError(
+                    f"paged decode steps one token at a time, got t={t}"
+                )
+            pool_shape = (self.num_pages, self.page_size, kv_local, head_dim)
+            pool_dtype = jnp.int8 if self.quant_kv_cache else k.dtype
+            kp = self.variable(
+                "pages", "key_pages", jnp.zeros, pool_shape, pool_dtype
+            )
+            vp = self.variable(
+                "pages", "value_pages", jnp.zeros, pool_shape, pool_dtype
+            )
+            if self.quant_kv_cache:
+                ksp = self.variable(
+                    "pages", "key_scale_pages", jnp.ones, pool_shape[:3],
+                    jnp.float32,
+                )
+                vsp = self.variable(
+                    "pages", "value_scale_pages", jnp.ones, pool_shape[:3],
+                    jnp.float32,
+                )
+            # Scatter the new token's K/V. Inactive slots are parked on
+            # the reserved trash page 0 by the engine — their writes
+            # collide there harmlessly (the page is never gathered by a
+            # live slot).
+            slot_page = jnp.take_along_axis(
+                page_table, (decode_pos // self.page_size)[:, None], axis=1
+            )[:, 0]
+            slot_off = decode_pos % self.page_size
+            if self.quant_kv_cache:
+                from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+                    paged_decode_attention_quant,
+                    quantize_kv,
+                )
+
+                kq, ks = quantize_kv(k)
+                vq, vs = quantize_kv(v)
+                kp.value = kp.value.at[slot_page, slot_off].set(kq[:, 0])
+                vp.value = vp.value.at[slot_page, slot_off].set(vq[:, 0])
+                ksp.value = ksp.value.at[slot_page, slot_off].set(ks[:, 0])
+                vsp.value = vsp.value.at[slot_page, slot_off].set(vs[:, 0])
+                paged_out = paged_decode_attention_quant(
+                    q, kp.value, vp.value, ksp.value, vsp.value,
+                    page_table, decode_pos,
+                )
+            else:
+                from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+                    paged_decode_attention,
+                )
+
+                kp.value = kp.value.at[slot_page, slot_off].set(k[:, 0])
+                vp.value = vp.value.at[slot_page, slot_off].set(v[:, 0])
+                paged_out = paged_decode_attention(
+                    q, kp.value, vp.value, page_table, decode_pos
+                )
+            decode_step = True
 
         interpret = (
             self.flash_interpret
@@ -368,7 +472,9 @@ class Attention(nn.Module):
 
             k, v = repeat_kv(k, rep), repeat_kv(v, rep)
         if decode_step:
-            if self.quant_kv_cache:
+            if mode == "paged_decode":
+                out = paged_out
+            elif self.quant_kv_cache:
                 from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
                     decode_attention_quant,
                 )
@@ -464,6 +570,9 @@ class Block(nn.Module):
     mlp: str = "gelu"
     norm_eps: float = 1e-6
     attn_bias: bool = False
+    # Paged KV pool geometry for mode="paged_decode" (serve/engine.py).
+    page_size: int | None = None
+    num_pages: int | None = None
 
     @nn.compact
     def __call__(
@@ -473,6 +582,7 @@ class Block(nn.Module):
         *,
         mode: str = "train",
         decode_pos: jnp.ndarray | None = None,
+        page_table: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         # ``deterministic`` is positional (arg index 2 counting self) so
         # the remat wrapper can declare it static — as a kw-only arg it
@@ -523,8 +633,10 @@ class Block(nn.Module):
             quant_modules=self.quant_modules,
             quant_kv_cache=self.quant_kv_cache,
             attn_bias=self.attn_bias,
+            page_size=self.page_size,
+            num_pages=self.num_pages,
             name="attn",
-        )(h, mode=mode, decode_pos=decode_pos)
+        )(h, mode=mode, decode_pos=decode_pos, page_table=page_table)
         if self.dropout_rate > 0.0:
             attn_out = drop(name="attn_drop")(attn_out)
         x = x + attn_out
@@ -675,6 +787,12 @@ class TransformerLM(nn.Module):
     # silently change the sown aux-loss reduction, and routed blocks are
     # the pipeline engine's domain.
     scan_layers: bool = False
+    # Paged KV pool geometry for mode="paged_decode": per-layer pools of
+    # ``num_pages`` pages x ``page_size`` tokens in the "pages" variable
+    # collection, indexed by the ``page_table`` call kwarg
+    # (serve/engine.py owns allocation; docs/serving.md).
+    page_size: int | None = None
+    num_pages: int | None = None
 
     @nn.compact
     def __call__(
@@ -683,6 +801,7 @@ class TransformerLM(nn.Module):
         *,
         mode: str = "train",
         decode_pos: jnp.ndarray | None = None,
+        page_table: jnp.ndarray | None = None,
         deterministic: bool = True,
     ) -> jnp.ndarray:
         b, t_local = tokens.shape
@@ -693,9 +812,9 @@ class TransformerLM(nn.Module):
         # Global positions: a sequence-sharded block starts at the
         # device's offset along the seq axis, not at 0; a cached decode
         # step sits at its decode position.
-        if mode == "decode":
+        if mode in ("decode", "paged_decode"):
             if decode_pos is None:
-                raise ValueError("mode='decode' needs decode_pos")
+                raise ValueError(f"mode={mode!r} needs decode_pos")
             offset = decode_pos
         else:
             offset = (
@@ -704,7 +823,14 @@ class TransformerLM(nn.Module):
                 else 0
             )
         if not self.use_rope:
-            positions = offset + jnp.arange(t_local)
+            if jnp.ndim(offset):
+                # Per-slot positions ([B] decode_pos, paged decode): an
+                # explicit [B, t] table — the bare (B,)+(t,) broadcast
+                # would collapse to (B,) at t=1 and then mis-broadcast
+                # against x [B, 1, D].
+                positions = jnp.asarray(offset)[:, None] + jnp.arange(t_local)
+            else:
+                positions = offset + jnp.arange(t_local)
             x = x + nn.Embed(
                 self.max_seq_len, self.d_model, dtype=self.dtype,
                 name="pos_embed",
@@ -750,6 +876,8 @@ class TransformerLM(nn.Module):
             mlp=self.mlp,
             norm_eps=self.norm_eps,
             attn_bias=self.attn_bias,
+            page_size=self.page_size,
+            num_pages=self.num_pages,
         )
         if self.scan_layers:
             if self.num_experts > 0:
@@ -771,7 +899,7 @@ class TransformerLM(nn.Module):
                     return block(carry, deterministic), None
                 return (
                     block(carry, deterministic, mode=mode,
-                          decode_pos=decode_pos),
+                          decode_pos=decode_pos, page_table=page_table),
                     None,
                 )
 
@@ -779,8 +907,12 @@ class TransformerLM(nn.Module):
                 body,
                 # "intermediates" rides along (stacked per layer) so
                 # capture_intermediates debugging works under the scan;
-                # empty unless a capture filter is active.
-                variable_axes={"params": 0, "cache": 0, "intermediates": 0},
+                # empty unless a capture filter is active. "pages" stacks
+                # the per-layer paged KV pools the same way the cache
+                # stacks.
+                variable_axes={
+                    "params": 0, "cache": 0, "intermediates": 0, "pages": 0,
+                },
                 split_rngs={"params": True, "dropout": True},
                 length=self.num_layers,
             )(block_cls(**block_kw, name="blocks"), x)
@@ -798,7 +930,8 @@ class TransformerLM(nn.Module):
                     # and scanned paths agree in every mode (layout
                     # parity is the scan_layers contract).
                     x = block(
-                        x, deterministic, mode=mode, decode_pos=decode_pos
+                        x, deterministic, mode=mode, decode_pos=decode_pos,
+                        page_table=page_table,
                     )
         x = _norm_cls(self.norm, self.norm_eps)(dtype=self.dtype, name="ln_f")(x)
         if self.tie_embeddings:

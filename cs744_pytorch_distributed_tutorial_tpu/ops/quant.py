@@ -291,17 +291,48 @@ def decode_attention_quant(
     ) * scale
     scores = scores * k_scale.transpose(0, 2, 1)[:, :, None, None, :]
     neg = jnp.float32(-1e30)
-    k_pos = jnp.arange(cached_k.shape[1])
-    # Chunk rows sit at positions pos..pos+t-1 (see the float variant).
-    q_pos = pos + jnp.arange(t)
-    mask = k_pos[None, :] <= q_pos[:, None]
-    scores = jnp.where(mask[None, None, None, :, :], scores, neg)
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        decode_mask,
+    )
+
+    # Chunk rows sit at positions pos..pos+t-1 (see the float variant);
+    # pos may be [B] for per-slot depths (serve/).
+    scores = jnp.where(decode_mask(cached_k.shape[1], t, pos), scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
     pv = probs * v_scale.transpose(0, 2, 1)[:, :, None, None, :]
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", pv, cached_v.astype(jnp.float32)
     )
     return out.reshape(b, t, hq, d).astype(q.dtype)
+
+
+def paged_decode_attention_quant(
+    q: jax.Array,
+    key_pages: jax.Array,
+    value_pages: jax.Array,
+    key_scale_pages: jax.Array,
+    value_scale_pages: jax.Array,
+    page_table: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """``decode_attention_quant`` against paged int8 pools (``serve/``):
+    ``key_pages``/``value_pages`` are ``[num_pages, page_size, Hkv, D]``
+    int8 pools with per-row scale pools ``[num_pages, page_size, Hkv]``;
+    ``page_table`` ``[B, P]`` and per-slot depths ``pos`` ``[B]`` as in
+    the float variant. Gather first, then the exact int8 decode path —
+    parity with the dense int8 cache is structural."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        gather_pages,
+    )
+
+    return decode_attention_quant(
+        q,
+        gather_pages(key_pages, page_table),
+        gather_pages(value_pages, page_table),
+        gather_pages(key_scale_pages, page_table),
+        gather_pages(value_scale_pages, page_table),
+        pos,
+    )
 
 
 # All TransformerLM Dense modules whose kernels CAN quantize (embeddings

@@ -90,6 +90,11 @@ def decode_attention(
     query-head width, which is GQA's decode-bandwidth saving. Same
     numerics discipline as the other variants: float32 scores/softmax,
     PV matmul in the cache dtype.
+
+    ``pos`` may also be a ``[B]`` vector (the continuous-batching serve
+    path, ``serve/``): row ``b``'s chunk then sits at global positions
+    ``pos[b]..pos[b]+t-1`` and each row masks against its OWN visible
+    prefix — slots at different depths share one fixed-shape decode step.
     """
     b, t, hq, d = q.shape
     hkv = cached_k.shape[2]
@@ -101,15 +106,66 @@ def decode_attention(
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk", qg, cached_k, preferred_element_type=jnp.float32
     ) * scale
-    k_pos = jnp.arange(cached_k.shape[1])
-    q_pos = pos + jnp.arange(t)
-    mask = k_pos[None, :] <= q_pos[:, None]  # [t, L]
-    scores = jnp.where(mask[None, None, None, :, :], scores, _MASK)
+    scores = jnp.where(
+        decode_mask(cached_k.shape[1], t, pos), scores, _MASK
+    )
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", probs.astype(cached_v.dtype), cached_v,
     )
     return out.reshape(b, t, hq, d)
+
+
+def decode_mask(cache_len: int, t: int, pos: jax.Array) -> jax.Array:
+    """Visibility mask for decode steps, broadcastable against
+    ``[B, Hkv, group, t, L]`` scores: key position ``k`` is visible to
+    query row ``i`` iff ``k <= pos + i``. Scalar ``pos`` gives the
+    classic shared-position mask ``[1, 1, 1, t, L]``; a ``[B]`` vector
+    gives per-row masks ``[B, 1, 1, t, L]`` (per-slot depths in the
+    serving engine)."""
+    k_pos = jnp.arange(cache_len)
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        q_pos = pos + jnp.arange(t)
+        return (k_pos[None, :] <= q_pos[:, None])[None, None, None]  # [t, L]
+    if pos.ndim != 1:
+        raise ValueError(f"pos must be a scalar or [B] vector, got {pos.shape}")
+    q_pos = pos[:, None] + jnp.arange(t)  # [B, t]
+    return (k_pos[None, None, :] <= q_pos[:, :, None])[:, None, None]
+
+
+def gather_pages(pages: jax.Array, page_table: jax.Array) -> jax.Array:
+    """Materialize each slot's contiguous KV view from a paged pool.
+
+    ``pages`` is ``[num_pages, page_size, ...]`` (one pool per layer);
+    ``page_table`` is ``[B, P]`` page indices in sequence order, so the
+    gathered ``[B, P*page_size, ...]`` view places token position ``i``
+    of slot ``b`` at row ``i`` — exactly the dense-cache layout, which is
+    what keeps paged decode bitwise-parity-exact with the dense path
+    (tests/test_serve.py)."""
+    b, p = page_table.shape
+    g = pages[page_table]  # [B, P, page_size, ...]
+    return g.reshape(b, p * pages.shape[1], *pages.shape[2:])
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    key_pages: jax.Array,
+    value_pages: jax.Array,
+    page_table: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """``decode_attention`` against a paged KV pool (``serve/``).
+
+    ``key_pages``/``value_pages`` are ``[num_pages, page_size, Hkv, D]``
+    pools shared by every slot; ``page_table`` ``[B, P]`` lists each
+    slot's pages in sequence order and ``pos`` ``[B]`` the slots'
+    current depths. The gather produces the dense per-slot view and the
+    masking/softmax/PV path is literally ``decode_attention`` — paged
+    parity is structural, not approximate."""
+    gk = gather_pages(key_pages, page_table)
+    gv = gather_pages(value_pages, page_table)
+    return decode_attention(q, gk, gv, pos)
 
 
 def _kv_group(q, k):

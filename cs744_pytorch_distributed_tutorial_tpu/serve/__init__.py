@@ -1,0 +1,24 @@
+"""Request-level serving: continuous batching over a paged KV pool.
+
+``engine.ServingEngine`` runs the in-flight batching loop (fixed-shape
+jitted decode step over B slots; slots retire and refill independently;
+KV lives in per-layer page pools so memory scales with live tokens).
+``pool.PagePool`` owns page accounting, ``loadgen`` replays Poisson
+arrivals and reports TTFT / per-token latency / tokens-per-sec through
+the ``obs`` sinks. See docs/serving.md.
+"""
+
+from cs744_pytorch_distributed_tutorial_tpu.serve.engine import (  # noqa: F401
+    Request,
+    ServeConfig,
+    ServingEngine,
+)
+from cs744_pytorch_distributed_tutorial_tpu.serve.loadgen import (  # noqa: F401
+    Workload,
+    make_poisson_workload,
+    run_batch_baseline,
+    run_poisson,
+)
+from cs744_pytorch_distributed_tutorial_tpu.serve.pool import (  # noqa: F401
+    PagePool,
+)

@@ -1,0 +1,732 @@
+"""Continuous-batching serving engine over a paged KV pool.
+
+Batch-at-a-time generation (``infer/generate.py``) starts and finishes
+every request in a batch together: short requests pay for the longest
+one, and the dense ``[B, max_seq_len, H, D]`` cache spends HBM on
+padding. This engine serves at REQUEST granularity instead:
+
+- decode runs a fixed-shape jitted step over ``num_slots`` slots —
+  ``(params, pages, tokens[B], lengths[B], page_table[B,P], active[B],
+  key) -> (pages, next_tokens[B])`` — so batch membership changes
+  (retire, refill, preempt) without retracing (graftlint GL002; the
+  0-retrace contract is pinned by tests/test_serve.py);
+- KV lives in per-layer page POOLS (``[num_pages, page_size, Hkv, D]``,
+  the "pages" variable collection of ``mode="paged_decode"``), indexed
+  by each slot's row of the page table. Pool memory scales with LIVE
+  tokens across the engine, not B x max_seq_len, and a retired slot's
+  pages recycle immediately (``pool.PagePool``);
+- prefill is its own jitted program per prompt-length bucket: a dense
+  causal pass over the padded prompt, the first sampled token, and a
+  scatter of the prompt's KV rows into the slot's pages — all one
+  program, so the hand-off to the decode pool is a device-side commit.
+  Because it is a separate program from decode, running it on separate
+  mesh slices (prefill/decode disaggregation) is a deployment choice,
+  not a code change;
+- when the pool runs dry the engine PREEMPTS the most recently admitted
+  slot (LIFO victim): its pages free instantly and the request re-queues
+  with prompt+generated as the new prompt (recompute-style preemption).
+  Admission guarantees any single request fits the pool alone, so the
+  oldest request always completes — no deadlock.
+
+Paged decode is BITWISE-identical to the dense-cache path: the gathered
+page view reproduces the cache layout exactly and runs the same
+``decode_attention`` einsum (see ``parallel/ring_attention.py::
+paged_decode_attention``), so greedy engine output matches
+``make_generator`` token for token.
+
+Telemetry flows through ``obs`` sinks as ``kind:"serve"`` records
+(per-request TTFT / per-token decode latency / queue time) —
+``benchmarks/metrics_summary.py`` renders them and ``regress.py`` gates
+them. The decode step registers as graftcheck entrypoint ``lm-serve``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
+    check_decode_model,
+    sample_tokens,
+)
+from cs744_pytorch_distributed_tutorial_tpu.serve.pool import PagePool
+
+# cache leaf -> pages leaf: the prefill commit scatters the dense cache
+# rows a prefill pass wrote into the slot's pages. Names mirror the
+# cache's on purpose (models/transformer.py keeps them mechanical).
+_CACHE_TO_PAGES = {
+    "cached_key": "key_pages",
+    "cached_value": "value_pages",
+    "key_scale": "key_scale_pages",
+    "value_scale": "value_scale_pages",
+}
+
+
+@dataclass
+class ServeConfig:
+    """Engine geometry and sampling policy.
+
+    The page-table width ``max_pages_per_slot`` bounds one request's KV
+    (``max_pages_per_slot * page_size`` tokens); ``num_pages`` bounds
+    the LIVE total across all slots (page 0 is the reserved trash page,
+    so ``num_pages - 1`` are allocatable). HBM for KV is
+    ``num_pages * page_size`` token-rows per layer — compare against the
+    dense generator's ``B * max_seq_len`` (docs/serving.md).
+    """
+
+    num_slots: int = 4
+    page_size: int = 16
+    num_pages: int = 64
+    max_pages_per_slot: int = 8
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    eos_id: int | None = None
+    pad_id: int = 0
+    seed: int = 0
+
+
+@dataclass
+class Request:
+    """One generation request plus its engine-side lifecycle record."""
+
+    prompt: np.ndarray  # [T] int32 token ids
+    max_new_tokens: int
+    req_id: int = -1
+    arrival_time: float | None = None  # loadgen wall-clock; None = submit
+    # engine-owned lifecycle state
+    generated: list[int] = field(default_factory=list)
+    submit_time: float = 0.0
+    first_token_time: float | None = None
+    done_time: float | None = None
+    preemptions: int = 0
+    # recompute-preemption carries prompt+generated as the new prompt;
+    # these keep the ORIGINAL accounting across the re-queue.
+    orig_prompt_len: int = -1
+    orig_max_new_tokens: int = -1
+
+    @property
+    def output_tokens(self) -> int:
+        done = self.orig_max_new_tokens - self.max_new_tokens
+        return done + len(self.generated)
+
+
+@dataclass
+class _Slot:
+    req: Request
+    length: int  # committed KV rows (prompt + fed tokens)
+    pages: list[int]
+    last_tok: int
+    admit_seq: int  # global admission counter — LIFO preemption order
+
+
+class ServingEngine:
+    """In-flight batching loop over ``cfg.num_slots`` decode slots.
+
+    ``model`` is a decode-configured ``TransformerLM`` (``seq_axis``
+    unsharded — e.g. ``LMTrainer.decode_model()`` or
+    ``quantized_decode_model(kv_cache=True)``; tensor-parallel models
+    pass ``mesh=``/``param_specs=`` as with ``make_generator``). The
+    engine clones it with the page geometry; trained params drop in
+    unchanged.
+
+    Drive it with ``submit()`` + ``step()`` (one admission/decode
+    iteration; returns requests completed in it) or ``run()`` (loop to
+    drain). ``serve/loadgen.py`` adds wall-clock Poisson replay.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        cfg: ServeConfig,
+        *,
+        mesh: Any = None,
+        param_specs: Any = None,
+        sink: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        check_decode_model(model, "serving", allow_tensor=mesh is not None)
+        if getattr(model, "scan_layers", False):
+            raise ValueError(
+                "serving does not support scan_layers models yet (the "
+                "page commit indexes per-layer subtrees); decode from an "
+                "unrolled clone — unstack_block_params converts params"
+            )
+        if cfg.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {cfg.num_slots}")
+        if cfg.max_pages_per_slot < 1:
+            raise ValueError(
+                f"max_pages_per_slot must be >= 1, got {cfg.max_pages_per_slot}"
+            )
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.param_specs = param_specs
+        self.sink = sink
+        self.clock = clock
+        self.pool = PagePool(cfg.num_pages, cfg.page_size)
+        self.model = model.clone(
+            page_size=cfg.page_size, num_pages=cfg.num_pages
+        )
+        self.max_seq_len = model.max_seq_len
+
+        b, p = cfg.num_slots, cfg.max_pages_per_slot
+        self._queue: deque[Request] = deque()
+        self._slots: list[_Slot | None] = [None] * b
+        self._page_table = np.zeros((b, p), np.int32)  # 0 = trash page
+        self._next_id = 0
+        self._admit_seq = 0
+        self._step_count = 0
+        self._active_slot_steps = 0
+        self._preemptions = 0
+        self._completed: list[Request] = []
+        self._base_key = jax.random.key(cfg.seed)
+        self._prefill_cache: dict[int, Any] = {}  # bucket len -> jitted fn
+
+        self._pages = self._init_pages()
+        self._decode_step = self._build_decode_step()
+
+    # ---------------------------------------------------------- build
+
+    def _init_pages(self):
+        """Materialize the per-layer page pools ("pages" collection) via
+        ``eval_shape`` of the model's own variable init — shapes/dtypes
+        come from the model, zero params are ever materialized. Scale
+        pools init to ones (matching the in-model variable init); data
+        pools to zeros."""
+        cfg = self.cfg
+        b, p = cfg.num_slots, cfg.max_pages_per_slot
+        # A mesh-free clone yields GLOBAL kv-head shapes; the TP path
+        # then shards the pools over the tensor axis below.
+        shape_model = self.model.clone(tensor_axis=None, tensor_axis_size=1)
+
+        def init_fn():
+            return shape_model.init(
+                jax.random.key(0),
+                jnp.zeros((b, 1), jnp.int32),
+                mode="paged_decode",
+                decode_pos=jnp.zeros((b,), jnp.int32),
+                page_table=jnp.zeros((b, p), jnp.int32),
+            )["pages"]
+
+        shapes = jax.eval_shape(init_fn)
+
+        def materialize(path, s):
+            name = path[-1].key
+            if "scale" in name:
+                return jnp.ones(s.shape, s.dtype)
+            return jnp.zeros(s.shape, s.dtype)
+
+        pages = jax.tree_util.tree_map_with_path(materialize, shapes)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            specs = self._page_specs()
+            pages = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                pages,
+                specs,
+            )
+        return pages
+
+    def _page_specs(self):
+        """PartitionSpecs for the pools: KV heads shard over the tensor
+        axis (dim 2 of ``[num_pages, page_size, Hkv, D]`` pools and of
+        the ``[num_pages, page_size, Hkv]`` scale pools), everything
+        else replicated — the paged mirror of the tensor-sharded dense
+        cache in ``tp_decode_model``."""
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.model.tensor_axis
+
+        def spec(leaf):
+            return P(None, None, axis, None) if leaf.ndim == 4 else P(
+                None, None, axis
+            )
+
+        return jax.tree.map(spec, self._pages_shape_tree())
+
+    def _pages_shape_tree(self):
+        cfg = self.cfg
+        b, p = cfg.num_slots, cfg.max_pages_per_slot
+        shape_model = self.model.clone(tensor_axis=None, tensor_axis_size=1)
+
+        def init_fn():
+            return shape_model.init(
+                jax.random.key(0),
+                jnp.zeros((b, 1), jnp.int32),
+                mode="paged_decode",
+                decode_pos=jnp.zeros((b,), jnp.int32),
+                page_table=jnp.zeros((b, p), jnp.int32),
+            )["pages"]
+
+        return jax.eval_shape(init_fn)
+
+    def _build_decode_step(self):
+        """ONE jitted fixed-shape step for the engine's lifetime: every
+        argument is an array of static shape, so slot churn (retire /
+        refill / preempt — different page tables, lengths, actives)
+        re-runs the SAME executable. Pages are donated: XLA aliases the
+        pool buffers in place, the step allocates no new pool."""
+        cfg = self.cfg
+        model = self.model
+
+        def step(params, pages, tokens, lengths, page_table, active, key):
+            logits, mutated = model.apply(
+                {"params": params, "pages": pages},
+                tokens[:, None],
+                mode="paged_decode",
+                decode_pos=lengths,
+                page_table=page_table,
+                mutable=["pages"],
+            )
+            tok = sample_tokens(
+                logits[:, 0].astype(jnp.float32),
+                key,
+                temperature=cfg.temperature,
+                top_k=cfg.top_k,
+                top_p=cfg.top_p,
+            )
+            tok = jnp.where(active, tok, cfg.pad_id).astype(jnp.int32)
+            return mutated["pages"], tok
+
+        if self.mesh is None:
+            return jax.jit(step, donate_argnums=(1,))
+        from jax.sharding import PartitionSpec as P
+
+        page_specs = self._page_specs()
+        rep = P()
+        return jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(self.param_specs, page_specs, rep, rep, rep, rep,
+                          rep),
+                out_specs=(page_specs, rep),
+                check_vma=False,
+            ),
+            donate_argnums=(1,),
+        )
+
+    def _prefill_fn(self, bucket: int):
+        """Jitted prefill+commit for one prompt-length bucket: dense
+        causal pass over the padded prompt, sample the first token from
+        the true last position, scatter the prompt's KV rows into the
+        slot's pages. One trace per bucket (buckets are powers of two —
+        a bounded set); true_len/page_row are traced arrays, so every
+        prompt in the bucket reuses the executable."""
+        cached = self._prefill_cache.get(bucket)
+        if cached is not None:
+            return cached
+        cfg = self.cfg
+        model = self.model
+        page_size = cfg.page_size
+
+        def commit(pages, cache, page_row, true_len):
+            idx = jnp.arange(bucket)
+            # Rows past the true prompt land on the trash page: junk KV
+            # written where no live slot ever gathers.
+            pidx = jnp.where(idx < true_len, page_row[idx // page_size], 0)
+            off = idx % page_size
+
+            def walk(p, c):
+                if any(k in p for k in _CACHE_TO_PAGES.values()):
+                    return {
+                        pname: p[pname].at[pidx, off].set(c[cname][0, :bucket])
+                        for cname, pname in _CACHE_TO_PAGES.items()
+                        if pname in p
+                    }
+                return {k: walk(p[k], c[k]) for k in p}
+
+            return walk(pages, cache)
+
+        def prefill(params, pages, prompt, true_len, page_row, key):
+            logits, mutated = model.apply(
+                {"params": params}, prompt, mode="prefill", mutable=["cache"]
+            )
+            last = lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)
+            tok = sample_tokens(
+                last[:, 0].astype(jnp.float32),
+                key,
+                temperature=cfg.temperature,
+                top_k=cfg.top_k,
+                top_p=cfg.top_p,
+            )
+            pages = commit(pages, mutated["cache"], page_row, true_len)
+            return pages, tok[0].astype(jnp.int32)
+
+        if self.mesh is None:
+            fn = jax.jit(prefill, donate_argnums=(1,))
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            page_specs = self._page_specs()
+            rep = P()
+            fn = jax.jit(
+                jax.shard_map(
+                    prefill,
+                    mesh=self.mesh,
+                    in_specs=(self.param_specs, page_specs, rep, rep, rep,
+                              rep),
+                    out_specs=(page_specs, rep),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+        self._prefill_cache[bucket] = fn
+        return fn
+
+    @staticmethod
+    def _bucket_for(n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    # ------------------------------------------------------ admission
+
+    def submit(self, req: Request) -> Request:
+        """Queue a request. Raises if it can NEVER fit (admission-time
+        capacity check — this is what makes preemption deadlock-free:
+        any admitted request fits the pool alone, so the oldest active
+        request always completes)."""
+        req.prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        if req.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
+            )
+        if req.orig_prompt_len < 0:
+            req.orig_prompt_len = int(req.prompt.size)
+            req.orig_max_new_tokens = int(req.max_new_tokens)
+        total = int(req.prompt.size) + int(req.max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({req.prompt.size}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_seq_len "
+                f"({self.max_seq_len})"
+            )
+        # KV rows a request can occupy: prompt + budget - 1 (the final
+        # sampled token is never fed back, so its KV is never written).
+        need = self.pool.pages_for(total - 1)
+        cap = min(self.cfg.max_pages_per_slot, self.cfg.num_pages - 1)
+        if need > cap:
+            raise ValueError(
+                f"request needs {need} pages ({total - 1} KV rows at "
+                f"page_size {self.cfg.page_size}); the engine caps a slot "
+                f"at {cap} pages — raise max_pages_per_slot/num_pages or "
+                "shrink the request"
+            )
+        if req.req_id < 0:
+            req.req_id = self._next_id
+            self._next_id += 1
+        req.submit_time = self.clock()
+        if req.arrival_time is None:
+            req.arrival_time = req.submit_time
+        self._queue.append(req)
+        return req
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._queue) or any(s is not None for s in self._slots)
+
+    # ------------------------------------------------------ scheduling
+
+    def _preempt_lifo(self) -> bool:
+        """Free the most recently admitted active slot: its pages return
+        to the pool NOW and the request re-queues (front) with
+        prompt+generated as the new prompt — recompute-style preemption.
+        Returns False when nothing is active to preempt."""
+        victim_idx = -1
+        for i, s in enumerate(self._slots):
+            if s is not None and (
+                victim_idx < 0
+                or s.admit_seq > self._slots[victim_idx].admit_seq
+            ):
+                victim_idx = i
+        if victim_idx < 0:
+            return False
+        slot = self._slots[victim_idx]
+        req = slot.req
+        req.preemptions += 1
+        self._preemptions += 1
+        # prompt + everything generated so far (minus nothing: the last
+        # sampled token re-enters as prompt tail and its KV recomputes)
+        req.prompt = np.concatenate(
+            [req.prompt, np.asarray(req.generated, np.int32)]
+        )
+        req.max_new_tokens -= len(req.generated)
+        req.generated = []
+        self._free_slot(victim_idx)
+        if req.max_new_tokens >= 1:
+            self._queue.appendleft(req)
+        else:  # budget spent exactly at preemption — it is just done
+            self._finish(req)
+        return True
+
+    def _free_slot(self, i: int) -> None:
+        slot = self._slots[i]
+        self.pool.free(slot.pages)
+        self._page_table[i, :] = 0
+        self._slots[i] = None
+
+    def _ensure_pages(self, n: int) -> bool:
+        """Make n pages allocatable, preempting LIFO as needed."""
+        while not self.pool.can_alloc(n):
+            if not self._preempt_lifo():
+                return False
+        return True
+
+    def _admit(self, slot_idx: int, req: Request) -> None:
+        plen = int(req.prompt.size)
+        need = max(1, self.pool.pages_for(plen))
+        pages = self.pool.alloc(need)
+        row = np.zeros((self.cfg.max_pages_per_slot,), np.int32)
+        row[: len(pages)] = pages
+        bucket = self._bucket_for(plen)
+        prompt = np.zeros((1, bucket), np.int32)
+        prompt[0, :plen] = req.prompt
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, 1), req.req_id
+        )
+        self._pages, first_tok = self._prefill_fn(bucket)(
+            self.params,
+            self._pages,
+            jnp.asarray(prompt),
+            jnp.int32(plen),
+            jnp.asarray(row),
+            key,
+        )
+        tok = int(first_tok)  # blocks — the request's first token
+        now = self.clock()
+        if req.first_token_time is None:
+            req.first_token_time = now
+        req.generated.append(tok)
+        self._admit_seq += 1
+        self._slots[slot_idx] = _Slot(
+            req=req, length=plen, pages=pages, last_tok=tok,
+            admit_seq=self._admit_seq,
+        )
+        self._page_table[slot_idx, :] = row
+        if self._slot_done(self._slots[slot_idx]):
+            self._retire(slot_idx)
+
+    def _slot_done(self, slot: _Slot) -> bool:
+        if len(slot.req.generated) >= slot.req.max_new_tokens:
+            return True
+        return (
+            self.cfg.eos_id is not None and slot.last_tok == self.cfg.eos_id
+        )
+
+    def _retire(self, i: int) -> None:
+        req = self._slots[i].req
+        self._free_slot(i)
+        self._finish(req)
+
+    def _finish(self, req: Request) -> None:
+        req.done_time = self.clock()
+        self._completed.append(req)
+        if self.sink is not None:
+            ttft_ms = (req.first_token_time - req.arrival_time) * 1e3
+            queue_ms = (req.submit_time - req.arrival_time) * 1e3
+            decode_s = req.done_time - req.first_token_time
+            out = req.output_tokens
+            self.sink.emit({
+                "kind": "serve",
+                "event": "request",
+                "time": time.time(),
+                "id": req.req_id,
+                "prompt_tokens": req.orig_prompt_len,
+                "output_tokens": out,
+                "queue_ms": round(queue_ms, 3),
+                "ttft_ms": round(ttft_ms, 3),
+                "decode_ms_per_token": round(
+                    decode_s * 1e3 / max(1, out - 1), 4
+                ),
+                "preemptions": req.preemptions,
+            })
+
+    # ------------------------------------------------------------ loop
+
+    def step(self) -> list[Request]:
+        """One engine iteration: refill free slots from the queue
+        (prefill+commit each), grow page tables for slots crossing a
+        page boundary (preempting LIFO if the pool is dry), then run ONE
+        fixed-shape decode step over all slots and retire the finished.
+        Returns the requests completed during this iteration."""
+        done_before = len(self._completed)
+
+        # refill — FCFS with head-of-line blocking: a new request only
+        # admits when its prompt's pages are FREE. Never preempt to
+        # admit (the queue head is by definition younger than every
+        # active request — killing running work for it would invert
+        # priority and can livelock with re-queued victims).
+        for i in range(self.cfg.num_slots):
+            if not self._queue:
+                break
+            if self._slots[i] is not None:
+                continue
+            plen = int(self._queue[0].prompt.size)
+            if not self.pool.can_alloc(max(1, self.pool.pages_for(plen))):
+                break
+            self._admit(i, self._queue.popleft())
+
+        # grow: every active slot needs a page for the KV row its next
+        # fed token writes (position slot.length)
+        for i in range(self.cfg.num_slots):
+            slot = self._slots[i]
+            if slot is None or self._slot_done(slot):
+                continue
+            page_idx = slot.length // self.cfg.page_size
+            if page_idx < len(slot.pages):
+                continue
+            if not self._ensure_pages(1):
+                raise RuntimeError("page pool dry with no active slots")
+            slot = self._slots[i]  # _ensure_pages may have preempted i
+            if slot is None or slot.length // self.cfg.page_size < len(
+                slot.pages
+            ):
+                continue
+            new_page = self.pool.alloc(1)[0]
+            self._page_table[i, len(slot.pages)] = new_page
+            slot.pages.append(new_page)
+
+        if not any(s is not None for s in self._slots):
+            return self._completed[done_before:]
+
+        # decode one token for every active slot
+        cfg = self.cfg
+        tokens = np.full((cfg.num_slots,), cfg.pad_id, np.int32)
+        lengths = np.zeros((cfg.num_slots,), np.int32)
+        active = np.zeros((cfg.num_slots,), bool)
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            tokens[i] = slot.last_tok
+            lengths[i] = slot.length
+            active[i] = True
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._base_key, 2), self._step_count
+        )
+        self._pages, toks = self._decode_step(
+            self.params,
+            self._pages,
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+            jnp.asarray(self._page_table),
+            jnp.asarray(active),
+            key,
+        )
+        toks = np.asarray(toks)  # graftlint: disable=GL001 -- the scheduler NEEDS this sync: retire/refill decisions read the sampled tokens; one fetch per engine step, outside any jit
+        self._step_count += 1
+        self._active_slot_steps += int(active.sum())
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.length += 1
+            slot.last_tok = int(toks[i])
+            slot.req.generated.append(slot.last_tok)
+            if self._slot_done(slot):
+                self._retire(i)
+        return self._completed[done_before:]
+
+    def run(self) -> list[Request]:
+        """Drain: step until the queue and every slot are empty."""
+        while self.busy:
+            self.step()
+        return self._completed
+
+    # ------------------------------------------------------- reporting
+
+    def stats(self) -> dict[str, Any]:
+        steps = max(1, self._step_count)
+        return {
+            "requests_done": len(self._completed),
+            "decode_steps": self._step_count,
+            "slot_occupancy": self._active_slot_steps
+            / (steps * self.cfg.num_slots),
+            "page_high_water": self.pool.high_water,
+            "pages_allocatable": self.cfg.num_pages - 1,
+            "preemptions": self._preemptions,
+        }
+
+
+# ----------------------------------------------------------- graftcheck
+
+
+def make_serve_trace_entry(**overrides):
+    """A graftcheck ``TracedStep`` around the engine's real jitted
+    decode step: tiny paged transformer, the live argument shapes, the
+    donation contract on the page pools. The audits (``lm-serve``) lower
+    exactly what serving runs."""
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        TracedStep,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+        TransformerLM,
+    )
+
+    kw: dict[str, Any] = dict(
+        vocab_size=64,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=64,
+        attention_impl="dense",
+        use_rope=True,
+    )
+    kw.update(overrides)
+    model = TransformerLM(**kw)
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cfg = ServeConfig(
+        num_slots=4, page_size=4, num_pages=17, max_pages_per_slot=8
+    )
+    eng = ServingEngine(model, params, cfg)
+    b, p = cfg.num_slots, cfg.max_pages_per_slot
+    args = (
+        params,
+        eng._pages,
+        jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b,), jnp.int32),
+        jnp.zeros((b, p), jnp.int32),
+        jnp.ones((b,), jnp.bool_),
+        jax.random.key(0),
+    )
+    return TracedStep(
+        name="lm-serve",
+        fn=eng._decode_step,
+        args=args,
+        axis_sizes={},
+        sync=None,
+        check_donation=True,
+        detail={
+            "num_slots": cfg.num_slots,
+            "page_size": cfg.page_size,
+            "num_pages": cfg.num_pages,
+        },
+    )
+
+
+def _register_serve_trace_entries() -> None:
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        register_entrypoint,
+    )
+
+    register_entrypoint(
+        "lm-serve", make_serve_trace_entry, tags=("lm", "serve")
+    )
+
+
+_register_serve_trace_entries()

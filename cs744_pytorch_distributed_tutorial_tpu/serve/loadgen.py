@@ -1,0 +1,306 @@
+"""Poisson load generation and the batch-at-a-time baseline.
+
+``make_poisson_workload`` draws a seeded open-loop trace (exponential
+inter-arrivals, uniform prompt/output lengths); ``run_poisson`` replays
+it against a ``ServingEngine`` on the wall clock and reports the serving
+metrics the ISSUE names:
+
+- **TTFT** (time to first token): first sampled token's host arrival
+  minus the request's scheduled arrival — it INCLUDES queue time, which
+  is the point (tail TTFT is where batch-at-a-time loses).
+- **per-token decode latency**: (done - first token) / (output - 1).
+- **aggregate tokens/sec**: total generated tokens / makespan (first
+  arrival to last completion).
+
+The baseline (``run_batch_baseline``) replays the SAME trace through
+``infer/generate.py``'s batch-at-a-time generator: requests batch in
+arrival order, the batch pads every prompt to its longest and decodes
+``max(output budgets)`` steps, and nothing streams out early — so a
+request's TTFT is when its whole batch returns. That is the measured
+definition, not a strawman: it is exactly what serving with the
+training-style generator would do. Both emit ``kind:"serve_summary"``
+records through the ``obs`` sinks; ``benchmarks/regress.py`` gates the
+p99/tokens-per-sec envelope in CI (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.serve.engine import (
+    Request,
+    ServingEngine,
+)
+
+
+@dataclass
+class Workload:
+    """A fully materialized open-loop trace (seeded, replayable)."""
+
+    arrivals: np.ndarray  # [N] seconds from trace start, sorted
+    prompts: list[np.ndarray]  # [N] int32 token vectors
+    max_new_tokens: np.ndarray  # [N] int32
+
+    def __len__(self) -> int:
+        return len(self.prompts)
+
+
+def make_poisson_workload(
+    *,
+    num_requests: int,
+    rate_rps: float,
+    prompt_len: tuple[int, int],
+    output_len: tuple[int, int],
+    vocab_size: int,
+    seed: int = 0,
+) -> Workload:
+    """Poisson arrivals at ``rate_rps`` with uniform prompt/output
+    lengths in the given inclusive ranges. Token ids avoid 0 (the
+    conventional pad id)."""
+    if num_requests < 1:
+        raise ValueError(f"num_requests must be >= 1, got {num_requests}")
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=num_requests)
+    gaps[0] = 0.0  # first request arrives at t=0 — makespan starts there
+    arrivals = np.cumsum(gaps)
+    plens = rng.integers(prompt_len[0], prompt_len[1] + 1, num_requests)
+    olens = rng.integers(output_len[0], output_len[1] + 1, num_requests)
+    prompts = [
+        rng.integers(1, vocab_size, size=int(n)).astype(np.int32)
+        for n in plens
+    ]
+    return Workload(
+        arrivals=arrivals,
+        prompts=prompts,
+        max_new_tokens=olens.astype(np.int32),
+    )
+
+
+def _percentile(values: list[float], q: float) -> float:
+    return float(np.percentile(np.asarray(values), q)) if values else 0.0
+
+
+def _summarize(
+    label: str,
+    reqs: list[Request],
+    makespan: float,
+    extra: dict[str, Any],
+) -> dict[str, Any]:
+    ttfts = [
+        (r.first_token_time - r.arrival_time) * 1e3 for r in reqs
+    ]
+    per_tok = [
+        (r.done_time - r.first_token_time) * 1e3 / max(1, r.output_tokens - 1)
+        for r in reqs
+    ]
+    total_tokens = sum(r.output_tokens for r in reqs)
+    return {
+        "kind": "serve_summary",
+        "time": time.time(),
+        "engine": label,
+        "requests": len(reqs),
+        "total_output_tokens": int(total_tokens),
+        "makespan_s": round(makespan, 4),
+        "ttft_p50_ms": round(_percentile(ttfts, 50), 3),
+        "ttft_p99_ms": round(_percentile(ttfts, 99), 3),
+        "decode_ms_per_token_p50": round(_percentile(per_tok, 50), 4),
+        "tokens_per_sec": round(total_tokens / makespan, 2)
+        if makespan > 0
+        else 0.0,
+        **extra,
+    }
+
+
+def run_poisson(
+    engine: ServingEngine,
+    workload: Workload,
+    *,
+    sink: Any = None,
+    warmup: bool = True,
+) -> dict[str, Any]:
+    """Replay ``workload`` open-loop against the engine on the wall
+    clock and return (and emit) the ``serve_summary`` record.
+
+    ``warmup=True`` first runs one throwaway request per prefill bucket
+    plus a decode step, so compile time does not pollute the measured
+    TTFTs (and so the post-warmup 0-retrace contract covers the whole
+    measured run)."""
+    clock = engine.clock
+    if warmup:
+        buckets = sorted({engine._bucket_for(len(p)) for p in workload.prompts})
+        saved_sink, engine.sink = engine.sink, None  # no warmup records
+        try:
+            for b in buckets:
+                plen = min(b, engine.max_seq_len - 1)
+                engine.submit(
+                    Request(
+                        prompt=np.ones((plen,), np.int32), max_new_tokens=2
+                    )
+                )
+            engine.run()
+        finally:
+            engine.sink = saved_sink
+        # warmup requests must not count against the measurement
+        engine._completed.clear()
+        engine._preemptions = 0
+        engine._step_count = 0
+        engine._active_slot_steps = 0
+        engine.pool.high_water = engine.pool.allocated_pages
+
+    t0 = clock()
+    n = len(workload)
+    i = 0
+    while i < n or engine.busy:
+        now = clock() - t0
+        while i < n and workload.arrivals[i] <= now:
+            engine.submit(
+                Request(
+                    prompt=workload.prompts[i],
+                    max_new_tokens=int(workload.max_new_tokens[i]),
+                    arrival_time=t0 + float(workload.arrivals[i]),
+                )
+            )
+            i += 1
+        if engine.busy:
+            engine.step()
+        elif i < n:
+            # idle until the next arrival (open loop — do not pull it in
+            # early; the arrival process IS the experiment)
+            time.sleep(
+                min(0.002, max(0.0, float(workload.arrivals[i]) - now))
+            )
+    reqs = engine._completed[:]
+    makespan = max(r.done_time for r in reqs) - t0 if reqs else 0.0
+    record = _summarize(
+        "continuous",
+        reqs,
+        makespan,
+        {
+            **engine.stats(),
+            "num_slots": engine.cfg.num_slots,
+            "page_size": engine.cfg.page_size,
+            "num_pages": engine.cfg.num_pages,
+            "kv_pool_tokens": engine.cfg.num_pages * engine.cfg.page_size,
+        },
+    )
+    if sink is not None:
+        sink.emit(record)
+        # bench-shaped twins (metric + value) so regress.py gates the
+        # serving envelope with its standard arithmetic — including the
+        # absolute budgets benchmarks/serve_smoke_budget.json arms.
+        for metric, value, unit in (
+            ("serve_tokens_per_sec", record["tokens_per_sec"], "tokens/sec"),
+            ("serve_ttft_p99_ms", record["ttft_p99_ms"], "ms"),
+        ):
+            sink.emit({
+                "kind": "bench",
+                "time": time.time(),
+                "metric": metric,
+                "value": value,
+                "unit": unit,
+            })
+    return record
+
+
+def run_batch_baseline(
+    model: Any,
+    params: Any,
+    workload: Workload,
+    *,
+    batch_size: int,
+    temperature: float = 0.0,
+    eos_id: int | None = None,
+    sink: Any = None,
+    warmup: bool = True,
+) -> dict[str, Any]:
+    """Replay the workload through batch-at-a-time ``make_generator``:
+    requests group into arrival-order batches of ``batch_size``, a batch
+    launches once its last member has arrived, every prompt right-pads
+    to the batch's longest, and the loop runs the batch's LONGEST output
+    budget. Tokens past a request's own budget are discarded (they were
+    still computed — that is the waste being measured). TTFT for every
+    request in a batch is the batch's return time.
+
+    The generator's dense KV cache holds ``batch_size * max_seq_len``
+    token rows; compare ``kv_cache_tokens`` in the summary against the
+    engine's ``kv_pool_tokens`` for the equal-HBM framing."""
+    from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+
+    if batch_size < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+    budget_max = int(np.max(workload.max_new_tokens))
+    gen = make_generator(
+        model,
+        max_new_tokens=budget_max,
+        temperature=temperature,
+        eos_id=eos_id,
+    )
+    plen_max = max(len(p) for p in workload.prompts)
+    if warmup:
+        gen(
+            params,
+            np.ones((batch_size, plen_max), np.int32),
+            jax.random.key(0),
+        )[0].block_until_ready()
+
+    clock = time.monotonic
+    t0 = clock()
+    reqs: list[Request] = []
+    n = len(workload)
+    for start in range(0, n, batch_size):
+        idx = list(range(start, min(start + batch_size, n)))
+        batch_arrival = t0 + float(workload.arrivals[idx[-1]])
+        now = clock()
+        if now < batch_arrival:
+            time.sleep(batch_arrival - now)
+        plen = max(len(workload.prompts[j]) for j in idx)
+        prompt = np.zeros((batch_size, plen), np.int32)
+        for row, j in enumerate(idx):
+            p = workload.prompts[j]
+            # right-padded: shorter prompts condition on pad tokens past
+            # their true length — one more batch-at-a-time artifact the
+            # per-request engine simply does not have
+            prompt[row, : len(p)] = p
+        launch = clock()
+        out = np.asarray(gen(params, prompt, jax.random.key(start)))
+        done = clock()
+        for row, j in enumerate(idx):
+            budget = int(workload.max_new_tokens[j])
+            toks = out[row, :budget].tolist()
+            if eos_id is not None and eos_id in toks:
+                toks = toks[: toks.index(eos_id) + 1]
+            r = Request(
+                prompt=workload.prompts[j],
+                max_new_tokens=budget,
+                req_id=j,
+                arrival_time=t0 + float(workload.arrivals[j]),
+            )
+            r.orig_prompt_len = len(workload.prompts[j])
+            r.orig_max_new_tokens = budget
+            r.generated = toks
+            r.submit_time = launch
+            # batch-at-a-time streams nothing: the first token a client
+            # sees arrives when the whole batch returns
+            r.first_token_time = done
+            r.done_time = done
+            reqs.append(r)
+    makespan = max(r.done_time for r in reqs) - t0 if reqs else 0.0
+    record = _summarize(
+        "batch",
+        reqs,
+        makespan,
+        {
+            "batch_size": batch_size,
+            "kv_cache_tokens": batch_size * model.max_seq_len,
+        },
+    )
+    if sink is not None:
+        sink.emit(record)
+    return record

@@ -1,0 +1,244 @@
+"""CLI for the continuous-batching serving engine (serve/).
+
+Runs the Poisson load benchmark against a paged-KV ``ServingEngine``
+and (optionally) the batch-at-a-time baseline at equal HBM budget,
+emitting ``kind:"serve"`` / ``kind:"serve_summary"`` records to stdout
+and ``--metrics-dir`` (docs/serving.md):
+
+    # engine vs batch-at-a-time generate, equal KV-token budget,
+    # exit 1 unless the engine wins p99 TTFT AND tokens/sec:
+    python -m cs744_pytorch_distributed_tutorial_tpu.serve_cli \
+        --requests 32 --rate 16 --compare-baseline --gate
+
+    # greedy paged-vs-dense parity audit over the workload's prompts:
+    python -m cs744_pytorch_distributed_tutorial_tpu.serve_cli \
+        --requests 8 --parity-check
+
+Params are randomly initialized — serving latency/throughput and the
+parity contract are weight-independent, so the CLI does not train.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cs744-tpu-serve",
+        description="Continuous-batching LM serving: Poisson load benchmark",
+    )
+    # model (decode-configured TransformerLM, random params)
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--num-layers", type=int, default=4)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--num-kv-heads", type=int, default=None)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--d-ff", type=int, default=1024)
+    p.add_argument("--max-seq-len", type=int, default=512)
+    p.add_argument("--use-rope", action="store_true")
+    p.add_argument("--quant-kv", action="store_true",
+                   help="int8 KV pages (ops/quant.py::quantize_kv)")
+    # engine geometry
+    p.add_argument("--num-slots", type=int, default=8,
+                   help="decode slots B in the fixed-shape jitted step")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="tokens per KV page")
+    p.add_argument("--num-pages", type=int, default=64,
+                   help="pool pages per layer (page 0 reserved as trash)")
+    p.add_argument("--max-pages-per-slot", type=int, default=16,
+                   help="page-table width P: caps one request's KV")
+    # sampling
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--eos-id", type=int, default=None)
+    # workload
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=16.0,
+                   help="Poisson arrival rate, requests/sec")
+    p.add_argument("--prompt-len", type=int, nargs=2, default=(8, 48),
+                   metavar=("MIN", "MAX"))
+    p.add_argument("--output-len", type=int, nargs=2, default=(8, 64),
+                   metavar=("MIN", "MAX"))
+    p.add_argument("--seed", type=int, default=0)
+    # modes
+    p.add_argument("--compare-baseline", action="store_true",
+                   help="also replay through batch-at-a-time generate at "
+                        "EQUAL KV HBM (batch = pool tokens / max_seq_len)")
+    p.add_argument("--gate", action="store_true",
+                   help="exit 1 unless the engine beats the baseline on "
+                        "both aggregate tokens/sec and p99 TTFT "
+                        "(implies --compare-baseline)")
+    p.add_argument("--parity-check", action="store_true",
+                   help="greedy engine output must match make_generator "
+                        "token-for-token on every workload prompt; exit 1 "
+                        "on any mismatch")
+    p.add_argument("--metrics-dir", default=None,
+                   help="also write records to METRICS_DIR/metrics.jsonl")
+    return p
+
+
+def _make_sink(metrics_dir: str | None):
+    from cs744_pytorch_distributed_tutorial_tpu.obs.sinks import (
+        JsonlSink,
+        MultiSink,
+        StreamSink,
+    )
+
+    sinks = [StreamSink(sys.stdout)]
+    if metrics_dir:
+        import os
+
+        os.makedirs(metrics_dir, exist_ok=True)
+        sinks.append(JsonlSink(os.path.join(metrics_dir, "metrics.jsonl")))
+    return MultiSink(sinks)
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cs744_pytorch_distributed_tutorial_tpu.models.transformer import (
+        TransformerLM,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.serve import (
+        Request,
+        ServeConfig,
+        ServingEngine,
+        make_poisson_workload,
+        run_batch_baseline,
+        run_poisson,
+    )
+
+    model = TransformerLM(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        num_kv_heads=args.num_kv_heads,
+        d_model=args.d_model,
+        d_ff=args.d_ff,
+        max_seq_len=args.max_seq_len,
+        attention_impl="dense",
+        use_rope=args.use_rope,
+        quant_kv_cache=args.quant_kv,
+    )
+    params = model.init(
+        jax.random.key(args.seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cfg = ServeConfig(
+        num_slots=args.num_slots,
+        page_size=args.page_size,
+        num_pages=args.num_pages,
+        max_pages_per_slot=args.max_pages_per_slot,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_id=args.eos_id,
+        seed=args.seed,
+    )
+    workload = make_poisson_workload(
+        num_requests=args.requests,
+        rate_rps=args.rate,
+        prompt_len=tuple(args.prompt_len),
+        output_len=tuple(args.output_len),
+        vocab_size=args.vocab_size,
+        seed=args.seed,
+    )
+    sink = _make_sink(args.metrics_dir)
+    failed = False
+    try:
+        if args.parity_check:
+            from cs744_pytorch_distributed_tutorial_tpu.infer import (
+                make_generator,
+            )
+
+            engine = ServingEngine(model, params, cfg, sink=None)
+            for i, prompt in enumerate(workload.prompts):
+                engine.submit(Request(
+                    prompt=prompt,
+                    max_new_tokens=int(workload.max_new_tokens[i]),
+                ))
+            by_id = {r.req_id: r for r in engine.run()}
+            gens: dict[int, object] = {}
+            mismatches = 0
+            for i, prompt in enumerate(workload.prompts):
+                n = int(workload.max_new_tokens[i])
+                if n not in gens:
+                    gens[n] = make_generator(
+                        model, max_new_tokens=n, temperature=0.0,
+                        eos_id=cfg.eos_id,
+                    )
+                ref = np.asarray(
+                    gens[n](params, prompt[None, :], jax.random.key(0))
+                )[0].tolist()
+                if cfg.eos_id is not None and cfg.eos_id in ref:
+                    ref = ref[: ref.index(cfg.eos_id) + 1]
+                if by_id[i].generated != ref:
+                    mismatches += 1
+            sink.emit({
+                "kind": "serve",
+                "event": "parity",
+                "requests": len(workload),
+                "mismatches": mismatches,
+                "parity_ok": mismatches == 0,
+            })
+            failed |= mismatches > 0
+
+        engine = ServingEngine(model, params, cfg, sink=sink)
+        serve_rec = run_poisson(engine, workload, sink=sink)
+
+        if args.compare_baseline or args.gate:
+            pool_tokens = cfg.num_pages * cfg.page_size
+            batch = max(1, pool_tokens // args.max_seq_len)
+            base_rec = run_batch_baseline(
+                model, params, workload,
+                batch_size=batch,
+                temperature=args.temperature,
+                eos_id=args.eos_id,
+                sink=sink,
+            )
+            comparison = {
+                "kind": "serve",
+                "event": "comparison",
+                "baseline_batch": batch,
+                "engine_kv_tokens": pool_tokens,
+                "baseline_kv_tokens": batch * args.max_seq_len,
+                "tokens_per_sec_ratio": round(
+                    serve_rec["tokens_per_sec"]
+                    / max(1e-9, base_rec["tokens_per_sec"]), 3
+                ),
+                "ttft_p99_ratio": round(
+                    serve_rec["ttft_p99_ms"]
+                    / max(1e-9, base_rec["ttft_p99_ms"]), 3
+                ),
+                "engine_wins": (
+                    serve_rec["tokens_per_sec"] > base_rec["tokens_per_sec"]
+                    and serve_rec["ttft_p99_ms"] < base_rec["ttft_p99_ms"]
+                ),
+            }
+            sink.emit(comparison)
+            if args.gate and not comparison["engine_wins"]:
+                print(
+                    json.dumps({
+                        "gate": "serve",
+                        "error": "continuous batching did not beat the "
+                                 "batch-at-a-time baseline on both "
+                                 "tokens/sec and p99 TTFT",
+                    }),
+                    file=sys.stderr,
+                )
+                failed = True
+    finally:
+        sink.close()
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

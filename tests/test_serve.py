@@ -1,0 +1,573 @@
+"""Continuous-batching serving engine (serve/): the PR's contracts.
+
+Four pins, in dependency order:
+
+1. **Paged == dense, bitwise.** ``mode="paged_decode"`` gathers the
+   slot's pages into the dense cache layout and runs the SAME
+   ``decode_attention`` einsum, so per-step logits must match the dense
+   cache path to the bit (float32 and int8-KV) — not approximately:
+   a tolerance here would hide an off-by-one page index.
+2. **Engine == make_generator, token for token** (greedy). The whole
+   request lifecycle — bucketed prefill+commit, slot decode, retire —
+   must reproduce batch-at-a-time generation per request.
+3. **Zero retraces across slot churn.** Retire/refill/preempt change
+   batch membership every which way; the fixed-shape decode step must
+   never recompile post-warmup (graftlint GL002 made executable).
+4. **Preemption is safe.** A pool too small for the offered load forces
+   LIFO recompute preemption; every request must still complete with
+   its full budget (admission guarantees the oldest always fits alone).
+
+Plus the host-side units (PagePool), the load generator's determinism
+and telemetry, and the regress.py budget gate the CI serve-smoke job
+relies on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.infer import make_generator
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+from cs744_pytorch_distributed_tutorial_tpu.serve import (
+    PagePool,
+    Request,
+    ServeConfig,
+    ServingEngine,
+    make_poisson_workload,
+    run_poisson,
+)
+
+VOCAB = 61
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(dict(record))
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    model = TransformerLM(
+        vocab_size=VOCAB,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=64,
+        attention_impl="dense",
+        use_rope=True,
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+# ---------------------------------------------------------------- pool
+
+
+def test_page_pool_reserves_trash_page():
+    pool = PagePool(num_pages=8, page_size=4)
+    assert pool.free_pages == 7  # page 0 reserved
+    got = pool.alloc(7)
+    assert 0 not in got
+    assert sorted(got) == list(range(1, 8))
+    with pytest.raises(RuntimeError, match="exhausted"):
+        pool.alloc(1)
+
+
+def test_page_pool_lifo_reuse_and_high_water():
+    pool = PagePool(num_pages=8, page_size=4)
+    a = pool.alloc(3)
+    assert a == [1, 2, 3]
+    pool.free([2])
+    # the just-freed page comes back first (LIFO)
+    assert pool.alloc(1) == [2]
+    assert pool.high_water == 3
+    pool.free([1, 2, 3])
+    assert pool.allocated_pages == 0
+    assert pool.high_water == 3  # high water does not recede
+
+
+def test_page_pool_rejects_bad_frees():
+    pool = PagePool(num_pages=8, page_size=4)
+    pages = pool.alloc(2)
+    pool.free(pages)
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([pages[0]])
+    with pytest.raises(ValueError, match="trash page"):
+        pool.free([0])
+    with pytest.raises(ValueError, match="out of range"):
+        pool.free([99])
+    with pytest.raises(ValueError, match="num_pages must be >= 2"):
+        PagePool(num_pages=1, page_size=4)
+
+
+def test_page_pool_pages_for_is_ceil():
+    pool = PagePool(num_pages=8, page_size=4)
+    assert [pool.pages_for(n) for n in (1, 4, 5, 8, 9)] == [1, 1, 2, 2, 3]
+
+
+# ------------------------------------------------- paged/dense parity
+
+
+def _commit_cache_to_pages(pages, cache, page_tables, true_len):
+    """Reference host-side commit: scatter each batch row's first
+    ``true_len`` dense-cache rows into that row's pages (the same
+    mapping the engine's fused prefill does on device)."""
+
+    def walk(p, c):
+        if "key_pages" in p:
+            out = {}
+            for cname, pname in (
+                ("cached_key", "key_pages"),
+                ("cached_value", "value_pages"),
+                ("key_scale", "key_scale_pages"),
+                ("value_scale", "value_scale_pages"),
+            ):
+                if pname not in p:
+                    continue
+                pool = np.asarray(p[pname]).copy()
+                rows = np.asarray(c[cname])
+                page_size = pool.shape[1]
+                for b in range(rows.shape[0]):
+                    for i in range(true_len):
+                        pool[page_tables[b, i // page_size], i % page_size] = (
+                            rows[b, i]
+                        )
+                out[pname] = jnp.asarray(pool)
+            return out
+        return {k: walk(p[k], c[k]) for k in p}
+
+    return walk(pages, cache)
+
+
+@pytest.mark.parametrize("quant_kv", [False, True])
+def test_paged_decode_logits_bitwise_match_dense(tiny_lm, quant_kv):
+    """Per-step decode logits from the page pools must equal the dense
+    cache path's EXACTLY (same einsum over a gathered view — any
+    difference is a paging bug, so no tolerance)."""
+    model, params = tiny_lm
+    page_size, num_pages, ppr = 4, 16, 4  # ppr = pages per row
+    dense = model.clone(quant_kv_cache=quant_kv)
+    paged = dense.clone(page_size=page_size, num_pages=num_pages)
+    B, t0, steps = 2, 6, 5
+    tokens = jax.random.randint(jax.random.key(1), (B, t0 + steps), 0, VOCAB)
+
+    # dense prefill gives both the reference cache and the rows to page
+    _, variables = dense.apply(
+        {"params": params}, tokens[:, :t0], mode="prefill", mutable=["cache"]
+    )
+    cache = variables["cache"]
+
+    page_tables = np.asarray(
+        [[1 + r * ppr + i for i in range(ppr)] for r in range(B)], np.int32
+    )
+    pages = paged.init(
+        jax.random.key(0),
+        jnp.zeros((B, 1), jnp.int32),
+        mode="paged_decode",
+        decode_pos=jnp.zeros((B,), jnp.int32),
+        page_table=jnp.asarray(page_tables),
+    )["pages"]
+    pages = _commit_cache_to_pages(pages, cache, page_tables, t0)
+
+    for pos in range(t0, t0 + steps):
+        step = tokens[:, pos : pos + 1]
+        dense_logits, mutated = dense.apply(
+            {"params": params, "cache": cache},
+            step,
+            mode="decode",
+            decode_pos=jnp.asarray(pos, jnp.int32),
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        paged_logits, mutated = paged.apply(
+            {"params": params, "pages": pages},
+            step,
+            mode="paged_decode",
+            decode_pos=jnp.full((B,), pos, jnp.int32),
+            page_table=jnp.asarray(page_tables),
+            mutable=["pages"],
+        )
+        pages = mutated["pages"]
+        np.testing.assert_array_equal(
+            np.asarray(paged_logits), np.asarray(dense_logits)
+        )
+
+
+# --------------------------------------------------- engine lifecycle
+
+
+def _reference_tokens(model, params, prompt, budget):
+    gen = make_generator(model, max_new_tokens=budget, temperature=0.0)
+    return np.asarray(
+        gen(params, np.asarray(prompt, np.int32)[None], jax.random.key(0))
+    )[0].tolist()
+
+
+def test_engine_greedy_matches_make_generator(tiny_lm):
+    """Request-level output == batch generator output, token for token,
+    across different prompt lengths, budgets, and admission order."""
+    model, params = tiny_lm
+    cfg = ServeConfig(num_slots=2, page_size=4, num_pages=33,
+                      max_pages_per_slot=8)
+    eng = ServingEngine(model, params, cfg)
+    rng = np.random.default_rng(7)
+    cases = [(3, 9), (7, 4), (12, 11), (5, 17), (9, 6)]
+    reqs = [
+        eng.submit(Request(
+            prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            max_new_tokens=budget,
+        ))
+        for plen, budget in cases
+    ]
+    eng.run()
+    assert all(r.done_time is not None for r in reqs)
+    for r in reqs:
+        expect = _reference_tokens(
+            model, params, r.prompt, r.max_new_tokens
+        )
+        assert r.generated == expect, (r.req_id, r.generated, expect)
+
+
+def test_engine_zero_retraces_across_slot_churn(tiny_lm):
+    """The fixed-shape decode step never recompiles once warm, no
+    matter how membership churns (the GL002 contract, measured)."""
+    from cs744_pytorch_distributed_tutorial_tpu.obs.system import (
+        CompileCounter,
+    )
+
+    model, params = tiny_lm
+    cfg = ServeConfig(num_slots=3, page_size=4, num_pages=33,
+                      max_pages_per_slot=8)
+    eng = ServingEngine(model, params, cfg)
+    rng = np.random.default_rng(11)
+
+    def burst(sizes):
+        for plen, budget in sizes:
+            eng.submit(Request(
+                prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+                max_new_tokens=budget,
+            ))
+        eng.run()
+
+    burst([(4, 3), (8, 5)])  # warmup: compiles prefill buckets + decode
+    cc = CompileCounter()
+    # same buckets, wildly different membership patterns
+    burst([(3, 8), (6, 2), (8, 7), (5, 3), (7, 12), (4, 2)])
+    assert cc.count == 0, f"{cc.count} retraces during slot churn"
+    assert len(eng._completed) == 8
+
+
+def test_engine_preemption_completes_everything(tiny_lm):
+    """A pool too small for the load forces LIFO recompute preemption;
+    every request still finishes with its FULL budget and greedy output
+    still matches the reference (recompute must be lossless)."""
+    model, params = tiny_lm
+    # 8 allocatable pages, slots want up to 7 each -> guaranteed fights
+    cfg = ServeConfig(num_slots=3, page_size=4, num_pages=9,
+                      max_pages_per_slot=7)
+    eng = ServingEngine(model, params, cfg)
+    rng = np.random.default_rng(13)
+    cases = [(6, 18), (10, 14), (8, 16), (5, 20), (12, 12)]
+    reqs = [
+        eng.submit(Request(
+            prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            max_new_tokens=budget,
+        ))
+        for plen, budget in cases
+    ]
+    eng.run()
+    assert eng.stats()["preemptions"] > 0, "pool was not tight enough"
+    for (plen, budget), r in zip(cases, reqs):
+        assert r.output_tokens == budget, (r.req_id, r.output_tokens)
+    # greedy determinism survives preemption: outputs equal the
+    # no-preemption reference (recompute re-derives the same KV, so the
+    # stream picks up exactly where it left off)
+    for (plen, budget), r in zip(cases, reqs):
+        # a preempted request's prompt absorbed its early generations;
+        # the produced stream is that absorbed tail + the final tail
+        produced = list(r.prompt[r.orig_prompt_len :]) + r.generated
+        expect = _reference_tokens(
+            model, params, r.prompt[: r.orig_prompt_len], budget
+        )
+        assert produced == expect, (r.req_id, produced, expect)
+
+
+def test_engine_pages_recycle(tiny_lm):
+    """After a drain every page is back in the pool, and high_water
+    stayed within the allocatable budget."""
+    model, params = tiny_lm
+    cfg = ServeConfig(num_slots=2, page_size=4, num_pages=17,
+                      max_pages_per_slot=8)
+    eng = ServingEngine(model, params, cfg)
+    rng = np.random.default_rng(17)
+    for plen, budget in [(4, 6), (9, 8), (6, 10), (11, 5)]:
+        eng.submit(Request(
+            prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            max_new_tokens=budget,
+        ))
+    eng.run()
+    assert eng.pool.allocated_pages == 0
+    assert eng.pool.free_pages == cfg.num_pages - 1
+    assert 0 < eng.pool.high_water <= cfg.num_pages - 1
+
+
+def test_engine_eos_stops_early(tiny_lm):
+    """An eos_id sampled mid-stream retires the slot before the budget
+    is spent (and the emitted record reflects the short output)."""
+    model, params = tiny_lm
+    budget = 12
+    prompt = np.asarray([1, 2, 3, 4], np.int32)
+    ref = _reference_tokens(model, params, prompt, budget)
+    eos = ref[3]  # force a stop 4 tokens in
+    sink = _ListSink()
+    cfg = ServeConfig(num_slots=2, page_size=4, num_pages=17,
+                      max_pages_per_slot=8, eos_id=eos)
+    eng = ServingEngine(model, params, cfg, sink=sink)
+    req = eng.submit(Request(prompt=prompt, max_new_tokens=budget))
+    eng.run()
+    assert req.generated == ref[:4]
+    recs = [r for r in sink.records if r.get("kind") == "serve"]
+    assert len(recs) == 1 and recs[0]["output_tokens"] == 4
+
+
+def test_engine_submit_validation(tiny_lm):
+    model, params = tiny_lm
+    cfg = ServeConfig(num_slots=2, page_size=4, num_pages=17,
+                      max_pages_per_slot=4)
+    eng = ServingEngine(model, params, cfg)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(Request(prompt=np.zeros((0,), np.int32), max_new_tokens=4))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(Request(prompt=np.ones((4,), np.int32), max_new_tokens=0))
+    with pytest.raises(ValueError, match="exceeds max_seq_len"):
+        eng.submit(Request(prompt=np.ones((60,), np.int32), max_new_tokens=8))
+    # fits max_seq_len but not a slot's page-table row
+    with pytest.raises(ValueError, match="caps a slot at 4 pages"):
+        eng.submit(Request(prompt=np.ones((20,), np.int32), max_new_tokens=8))
+
+
+def test_engine_rejects_scan_layers(tiny_lm):
+    model, params = tiny_lm
+    with pytest.raises(ValueError, match="scan_layers"):
+        ServingEngine(model.clone(scan_layers=True), params, ServeConfig())
+
+
+# ------------------------------------------------------------ loadgen
+
+
+def test_poisson_workload_is_seeded_and_bounded():
+    mk = lambda: make_poisson_workload(
+        num_requests=16, rate_rps=100.0, prompt_len=(3, 9),
+        output_len=(2, 7), vocab_size=VOCAB, seed=5,
+    )
+    w1, w2 = mk(), mk()
+    assert np.array_equal(w1.arrivals, w2.arrivals)
+    assert all(np.array_equal(a, b) for a, b in zip(w1.prompts, w2.prompts))
+    assert np.array_equal(w1.max_new_tokens, w2.max_new_tokens)
+    assert w1.arrivals[0] == 0.0
+    assert np.all(np.diff(w1.arrivals) >= 0)
+    assert all(3 <= len(p) <= 9 and p.min() >= 1 for p in w1.prompts)
+    assert w1.max_new_tokens.min() >= 2 and w1.max_new_tokens.max() <= 7
+    with pytest.raises(ValueError, match="rate_rps"):
+        make_poisson_workload(
+            num_requests=1, rate_rps=0.0, prompt_len=(3, 9),
+            output_len=(2, 7), vocab_size=VOCAB,
+        )
+
+
+def test_run_poisson_emits_summary_and_bench_twins(tiny_lm):
+    """One short open-loop replay: every request completes, the summary
+    record carries the serving metrics, and the bench-shaped twins
+    (metric/value) land on the sink for regress.py to gate. Warmup
+    requests must NOT leak into the sink or the counts."""
+    model, params = tiny_lm
+    sink = _ListSink()
+    cfg = ServeConfig(num_slots=3, page_size=4, num_pages=33,
+                      max_pages_per_slot=8)
+    eng = ServingEngine(model, params, cfg, sink=sink)
+    wl = make_poisson_workload(
+        num_requests=6, rate_rps=500.0, prompt_len=(3, 8),
+        output_len=(2, 6), vocab_size=VOCAB, seed=3,
+    )
+    record = run_poisson(eng, wl, sink=sink, warmup=True)
+    assert record["requests"] == 6
+    assert record["total_output_tokens"] == int(wl.max_new_tokens.sum())
+    assert record["tokens_per_sec"] > 0
+    assert record["ttft_p99_ms"] >= record["ttft_p50_ms"] >= 0
+
+    serve_recs = [r for r in sink.records if r.get("kind") == "serve"]
+    assert len(serve_recs) == 6  # measured requests only, no warmup
+    assert len({r["id"] for r in serve_recs}) == 6
+    summaries = [r for r in sink.records if r.get("kind") == "serve_summary"]
+    assert len(summaries) == 1 and summaries[0]["engine"] == "continuous"
+    twins = {
+        r["metric"]: r["value"]
+        for r in sink.records
+        if r.get("kind") == "bench"
+    }
+    assert twins["serve_tokens_per_sec"] == record["tokens_per_sec"]
+    assert twins["serve_ttft_p99_ms"] == record["ttft_p99_ms"]
+
+
+def test_metrics_summary_renders_serve_rows(tmp_path):
+    import importlib.util as ilu
+    import os
+
+    spec = ilu.spec_from_file_location(
+        "metrics_summary",
+        os.path.join(os.path.dirname(__file__), os.pardir, "benchmarks",
+                     "metrics_summary.py"),
+    )
+    ms = ilu.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+    records = [
+        {"kind": "serve_summary", "engine": "continuous", "requests": 6,
+         "ttft_p50_ms": 4.0, "ttft_p99_ms": 9.0, "tokens_per_sec": 310.0,
+         "page_high_water": 12, "slot_occupancy": 0.8, "preemptions": 1},
+        {"kind": "serve_summary", "engine": "batch", "requests": 6,
+         "ttft_p50_ms": 900.0, "ttft_p99_ms": 2900.0,
+         "tokens_per_sec": 40.0},
+    ]
+    summary = ms.summarize(records)
+    assert set(summary["serve"]) == {"continuous", "batch"}
+    assert summary["serve"]["continuous"]["tokens_per_sec"] == 310.0
+    assert summary["serve"]["batch"]["ttft_p99_ms"] == 2900.0
+
+
+# ------------------------------------------------------- regress gate
+
+
+def _regress():
+    import importlib.util as ilu
+    import os
+
+    spec = ilu.spec_from_file_location(
+        "regress",
+        os.path.join(os.path.dirname(__file__), os.pardir, "benchmarks",
+                     "regress.py"),
+    )
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_regress_generic_budgets_gate_serve_metrics():
+    """The serve_smoke_budget.json idiom: baseline records with
+    metric+budget arm absolute gates on the current stream — a
+    throughput floor (direction min) and a latency ceiling (max)."""
+    rg = _regress()
+    baseline = [
+        {"metric": "serve_tokens_per_sec", "value": 300.0, "budget": 40.0,
+         "direction": "min"},
+        {"metric": "serve_ttft_p99_ms", "value": 15.0, "budget": 1500.0,
+         "direction": "max"},
+    ]
+    current_ok = [
+        {"kind": "bench", "metric": "serve_tokens_per_sec", "value": 250.0},
+        {"kind": "bench", "metric": "serve_ttft_p99_ms", "value": 12.0},
+    ]
+    code, verdict = rg.evaluate(
+        baseline, current_ok, metric="serve_tokens_per_sec", tolerance=0.85
+    )
+    assert code == rg.PASS, verdict
+    assert all(b["ok"] for b in verdict["budgets"])
+
+    # p99 blows the ceiling -> REGRESSION even though throughput passes
+    current_slow = [
+        {"kind": "bench", "metric": "serve_tokens_per_sec", "value": 250.0},
+        {"kind": "bench", "metric": "serve_ttft_p99_ms", "value": 4000.0},
+    ]
+    code, verdict = rg.evaluate(
+        baseline, current_slow, metric="serve_tokens_per_sec", tolerance=0.85
+    )
+    assert code == rg.REGRESSION
+    bad = {b["metric"]: b["ok"] for b in verdict["budgets"]}
+    assert bad == {"serve_tokens_per_sec": True, "serve_ttft_p99_ms": False}
+
+    # throughput under the floor -> REGRESSION via the min-direction gate
+    current_weak = [
+        {"kind": "bench", "metric": "serve_tokens_per_sec", "value": 260.0},
+        {"kind": "bench", "metric": "serve_ttft_p99_ms", "value": 12.0},
+    ]
+    weak_floor = [dict(baseline[0], budget=290.0), baseline[1]]
+    code, _ = rg.evaluate(
+        weak_floor, current_weak, metric="serve_tokens_per_sec",
+        tolerance=0.85,
+    )
+    assert code == rg.REGRESSION
+
+    # an armed budget with no current values is MISSING, not a pass
+    code, verdict = rg.evaluate(
+        baseline,
+        [{"kind": "bench", "metric": "serve_tokens_per_sec", "value": 250.0}],
+        metric="serve_tokens_per_sec", tolerance=0.85,
+    )
+    assert code == rg.MISSING
+    assert "serve_ttft_p99_ms" in verdict["error"]
+
+
+# --------------------------------------------------- tensor-parallel
+
+
+@pytest.mark.slow
+def test_tp_engine_greedy_matches_gathered():
+    """Tensor-sharded serving: the engine on a tensor=2 mesh (KV pages
+    sharded over heads) must emit exactly the tokens the mesh-free
+    engine emits from the same (gathered) params."""
+    from cs744_pytorch_distributed_tutorial_tpu.data.text import (
+        synthetic_tokens,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
+        LMConfig,
+        LMTrainer,
+    )
+
+    mesh = make_mesh({"data": 2, "seq": 1, "tensor": 2},
+                     devices=jax.devices()[:4])
+    cfg = LMConfig(
+        vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_seq_len=64, attention_impl="dense", global_batch_size=4,
+        seq_len=16, seed=11, data_parallel=2, tensor_parallel=2,
+    )
+    tr = LMTrainer(cfg, mesh=mesh)
+    params, opt_state = tr.init()
+    toks = synthetic_tokens(8, 16, 64, seed=0)
+    for s in range(2):
+        x, y = tr.shard_batch(toks[s * 4 : s * 4 + 4])
+        params, opt_state, _ = tr.train_step(params, opt_state, x, y)
+
+    scfg = ServeConfig(num_slots=2, page_size=4, num_pages=33,
+                      max_pages_per_slot=8)
+    cases = [(4, 6), (7, 5), (5, 8)]
+    rng = np.random.default_rng(23)
+    prompts = [
+        rng.integers(1, 64, size=plen).astype(np.int32)
+        for plen, _ in cases
+    ]
+
+    def run(engine):
+        reqs = [
+            engine.submit(Request(prompt=p.copy(), max_new_tokens=budget))
+            for p, (_, budget) in zip(prompts, cases)
+        ]
+        engine.run()
+        return [r.generated for r in reqs]
+
+    tp_out = run(ServingEngine(
+        tr.tp_decode_model(), params, scfg,
+        mesh=tr.mesh, param_specs=tr.param_specs,
+    ))
+    gathered_out = run(ServingEngine(
+        tr.decode_model(), tr.gather_for_decode(params), scfg
+    ))
+    assert tp_out == gathered_out
