@@ -94,6 +94,7 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
             serve[r["engine"]] = {
                 k: r.get(k)
                 for k in ("requests", "ttft_p50_ms", "ttft_p99_ms",
+                          "itl_p50_ms", "itl_p99_ms",
                           "tokens_per_sec", "page_high_water",
                           "slot_occupancy", "preemptions")
             }
@@ -160,6 +161,8 @@ def main(argv: list[str] | None = None) -> int:
             f"serve {label}",
             f"{_fmt(row['requests'])} reqs, TTFT p50/p99 "
             f"{_fmt(row['ttft_p50_ms'])}/{_fmt(row['ttft_p99_ms'])} ms, "
+            f"ITL p50/p99 "
+            f"{_fmt(row.get('itl_p50_ms'))}/{_fmt(row.get('itl_p99_ms'))} ms, "
             f"{_fmt(row['tokens_per_sec'])} tok/s, pages hw "
             f"{_fmt(row.get('page_high_water'))}, occupancy "
             f"{_fmt(round(occ, 3) if isinstance(occ, float) else occ)}",

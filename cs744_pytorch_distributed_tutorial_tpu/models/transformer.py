@@ -187,6 +187,13 @@ class Attention(nn.Module):
     # use the paged mode.
     page_size: int | None = None
     num_pages: int | None = None
+    # Paged-decode attention implementation: "gather" materializes each
+    # slot's dense view via gather_pages + einsum (the reference,
+    # bitwise-parity-exact with the dense cache); "kernel" runs the
+    # Pallas paged-attention kernel (ops/paged_attention.py) that reads
+    # only live pages — tolerance-level parity (online softmax), HBM
+    # traffic scaling with live tokens instead of page capacity.
+    paged_attention_impl: str = "gather"
 
     @nn.compact
     def __call__(
@@ -373,10 +380,13 @@ class Attention(nn.Module):
             # LIVE tokens across the engine instead of B x max_seq_len,
             # and a retired slot's pages recycle immediately. The new
             # token's K/V scatters into (page_table[b, pos//page],
-            # pos%page); attention gathers the slot's pages into the
-            # dense per-slot view and runs the exact decode_attention
-            # path, so paged decode is bitwise-identical to the dense
-            # cache (tests/test_serve.py).
+            # pos%page); attention then either gathers the slot's pages
+            # into the dense per-slot view and runs the exact
+            # decode_attention path (impl="gather" — bitwise-identical
+            # to the dense cache, tests/test_serve.py), or runs the
+            # Pallas paged-attention kernel straight over the pools
+            # (impl="kernel" — reads only live pages, tolerance-level
+            # parity; ops/paged_attention.py).
             if self.seq_axis is not None and self.seq_axis_size > 1:
                 raise ValueError(
                     "paged decode requires an unsharded sequence axis; "
@@ -422,6 +432,16 @@ class Attention(nn.Module):
                 page_table, (decode_pos // self.page_size)[:, None], axis=1
             )[:, 0]
             slot_off = decode_pos % self.page_size
+            if self.paged_attention_impl not in ("gather", "kernel"):
+                raise ValueError(
+                    "paged_attention_impl must be 'gather' or 'kernel', "
+                    f"got {self.paged_attention_impl!r}"
+                )
+            use_kernel = self.paged_attention_impl == "kernel"
+            if use_kernel:
+                from cs744_pytorch_distributed_tutorial_tpu.ops.paged_attention import (
+                    paged_attention,
+                )
             if self.quant_kv_cache:
                 from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
                     paged_decode_attention_quant,
@@ -434,10 +454,21 @@ class Attention(nn.Module):
                 vp.value = vp.value.at[slot_page, slot_off].set(vq[:, 0])
                 ksp.value = ksp.value.at[slot_page, slot_off].set(ks[:, 0])
                 vsp.value = vsp.value.at[slot_page, slot_off].set(vs[:, 0])
-                paged_out = paged_decode_attention_quant(
-                    q, kp.value, vp.value, ksp.value, vsp.value,
-                    page_table, decode_pos,
-                )
+                if use_kernel:
+                    # Dequant happens INSIDE the kernel (per-key scales
+                    # ride the same clamped page index_map) — no gather
+                    # of any of the four pools.
+                    paged_out = paged_attention(
+                        q, kp.value, vp.value, page_table, decode_pos,
+                        key_scale_pages=ksp.value,
+                        value_scale_pages=vsp.value,
+                        interpret=self.flash_interpret,
+                    )
+                else:
+                    paged_out = paged_decode_attention_quant(
+                        q, kp.value, vp.value, ksp.value, vsp.value,
+                        page_table, decode_pos,
+                    )
             else:
                 from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
                     paged_decode_attention,
@@ -445,9 +476,15 @@ class Attention(nn.Module):
 
                 kp.value = kp.value.at[slot_page, slot_off].set(k[:, 0])
                 vp.value = vp.value.at[slot_page, slot_off].set(v[:, 0])
-                paged_out = paged_decode_attention(
-                    q, kp.value, vp.value, page_table, decode_pos
-                )
+                if use_kernel:
+                    paged_out = paged_attention(
+                        q, kp.value, vp.value, page_table, decode_pos,
+                        interpret=self.flash_interpret,
+                    )
+                else:
+                    paged_out = paged_decode_attention(
+                        q, kp.value, vp.value, page_table, decode_pos
+                    )
             decode_step = True
 
         interpret = (
@@ -573,6 +610,7 @@ class Block(nn.Module):
     # Paged KV pool geometry for mode="paged_decode" (serve/engine.py).
     page_size: int | None = None
     num_pages: int | None = None
+    paged_attention_impl: str = "gather"
 
     @nn.compact
     def __call__(
@@ -635,6 +673,7 @@ class Block(nn.Module):
             attn_bias=self.attn_bias,
             page_size=self.page_size,
             num_pages=self.num_pages,
+            paged_attention_impl=self.paged_attention_impl,
             name="attn",
         )(h, mode=mode, decode_pos=decode_pos, page_table=page_table)
         if self.dropout_rate > 0.0:
@@ -793,6 +832,9 @@ class TransformerLM(nn.Module):
     # (serve/engine.py owns allocation; docs/serving.md).
     page_size: int | None = None
     num_pages: int | None = None
+    # "gather" (reference, bitwise vs dense cache) or "kernel" (Pallas
+    # live-pages-only decode — ops/paged_attention.py; see Attention).
+    paged_attention_impl: str = "gather"
 
     @nn.compact
     def __call__(
@@ -878,6 +920,7 @@ class TransformerLM(nn.Module):
             attn_bias=self.attn_bias,
             page_size=self.page_size,
             num_pages=self.num_pages,
+            paged_attention_impl=self.paged_attention_impl,
         )
         if self.scan_layers:
             if self.num_experts > 0:

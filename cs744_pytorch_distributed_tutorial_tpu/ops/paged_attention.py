@@ -1,0 +1,258 @@
+"""Paged-attention decode as a Pallas TPU kernel — serve's HBM-bound path.
+
+The serving engine (``serve/``) keeps every slot's KV in a shared pool of
+fixed-size pages (``[num_pages, page_size, Hkv, D]`` per layer) indexed
+by a per-slot page table. The reference decode path
+(``parallel/ring_attention.py::paged_decode_attention``) gathers each
+slot's pages into the dense ``[B, P*page_size, Hkv, D]`` view and runs
+the standard einsum — correct (and bitwise-parity-testable against the
+dense cache), but its HBM traffic per step scales with the slot's page
+CAPACITY ``P``, not with how many tokens are actually live. Decode is
+memory-bound, so that is exactly the wrong scaling.
+
+This kernel reads **only live pages**, straight out of the pool:
+
+- Grid ``(slot, kv_head, page_block)`` with the page dimension fastest.
+  The page table and per-slot depths ride as **scalar-prefetched**
+  operands (``PrefetchScalarGridSpec``), so each grid step's BlockSpec
+  index_map picks its page from ``page_table[slot, i]`` — data-dependent
+  DMA, no gather, no dense intermediate.
+- Dead iterations (``i >= ceil((pos+1)/page_size)``) CLAMP their
+  index_map to the slot's last live page. Pallas skips the re-fetch when
+  a block index repeats, so capacity-sized grids cost live-sized HBM
+  reads — and the reserved trash page 0 is never touched past a slot's
+  first block boundary.
+- Flash-style online softmax (running max / normalizer / accumulator in
+  f32 VMEM scratch, ``ops/flash_attention.py`` discipline); the last
+  live page masks its tail rows by position, dead iterations are skipped
+  by ``pl.when``, and the output block flushes once at the end of each
+  (slot, head) pass.
+
+Three variants share this one entry point:
+
+- float (f32/bf16 pools): numerics follow ``decode_attention`` — f32
+  scores/softmax, PV matmul in the pool dtype.
+- int8-KV (``key/value_scale_pages`` given): dequant happens INSIDE the
+  kernel with the same algebra as ``ops/quant.py::decode_attention_quant``
+  (per-key ``k_scale`` on scores after the QK dot, ``v_scale`` folded
+  into the probabilities before PV) — the scale pools ride the same
+  clamped index_map, replacing ``paged_decode_attention_quant``'s
+  four-pool gather.
+- tensor-parallel: under ``shard_map`` the pools arrive sliced over KV
+  heads and ``q`` over query heads; the grid derives from the LOCAL
+  shapes, so the kernel partitions over the head axis with no changes.
+
+Online softmax reassociates the reduction, so kernel-vs-reference parity
+is tolerance-level (tests/test_paged_attention.py), not bitwise — the
+gather path remains the reference implementation and the engine's
+bitwise dense-parity story stays on it.
+
+``pages_per_slot`` statically prunes the page-table width and grid — the
+compiled ``cost_analysis`` bytes-read then scales with
+``ceil(live/page_size) * page_size`` instead of capacity, which is how
+CPU CI gates the win analytically (no TPU in the loop).
+
+``interpret=True`` runs the same kernel on any backend for tests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled builds; interpret mode needs it
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_NEG = -1e30
+
+
+def _decode_kernel(
+    page_size: int,
+    num_blocks: int,
+    scale: float,
+    quant: bool,
+    lens_ref,
+    pt_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    *rest,
+):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    pos = lens_ref[b]
+    # Page i holds positions [i*page_size, (i+1)*page_size); the slot's
+    # current token sits at ``pos``, so pages 0..pos//page_size are live.
+    live = pos // page_size + 1
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, _NEG, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    @pl.when(i < live)
+    def _update():
+        q = q_ref[0, 0]  # [group, D]
+        k = k_ref[0, :, 0, :]  # [page_size, D]
+        v = v_ref[0, :, 0, :]
+        if quant:
+            q, k = q.astype(jnp.float32), k.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [group, page_size] f32
+        if quant:
+            # Per-key dequant AFTER the dot — algebraically identical to
+            # scaling K first (decode_attention_quant's layout).
+            s = s * ks_ref[0, :, 0][None, :]
+        k_pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(k_pos <= pos, s, _NEG)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = correction * l_prev + p.sum(axis=-1, keepdims=True)
+        if quant:
+            pv = p * vs_ref[0, :, 0][None, :]
+            v = v.astype(jnp.float32)
+        else:
+            pv = p.astype(v.dtype)
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            pv, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # Position 0 is always visible (pos >= 0), so l > 0 — no NaN rows
+    # even for freshly-admitted or parked slots.
+    @pl.when(i == num_blocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    key_pages: jax.Array,
+    value_pages: jax.Array,
+    page_table: jax.Array,
+    pos: jax.Array,
+    *,
+    key_scale_pages: jax.Array | None = None,
+    value_scale_pages: jax.Array | None = None,
+    interpret: bool | None = None,
+    pages_per_slot: int | None = None,
+) -> jax.Array:
+    """One decode step of ``q`` [B, 1, Hq, D] against paged KV pools,
+    reading only each slot's live pages (module docstring).
+
+    ``key_pages``/``value_pages`` are ``[num_pages, page_size, Hkv, D]``
+    pools, ``page_table`` ``[B, P]`` page indices in sequence order, and
+    ``pos`` ``[B]`` the slots' current depths — the exact signature of
+    ``paged_decode_attention`` (+ scale pools for the int8 variant,
+    matching ``paged_decode_attention_quant``). ``Hq`` may be a multiple
+    of ``Hkv`` (GQA). ``pages_per_slot`` statically narrows the page
+    table and grid to the first N pages — the capacity stays a runtime
+    fact for the engine's fixed-shape step (live length enters via the
+    grid mask, never the shape), while analytical byte-accounting tests
+    pin it to make the live-scaling visible to ``cost_analysis``.
+    """
+    b, t, hq, d = q.shape
+    if t != 1:
+        raise ValueError(f"paged decode steps one token at a time, got t={t}")
+    num_pages, page_size, hkv, _ = key_pages.shape
+    if hq % hkv:
+        raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
+    quant = key_scale_pages is not None
+    if quant != (value_scale_pages is not None):
+        raise ValueError("pass both scale pools or neither")
+    if interpret is None:
+        from cs744_pytorch_distributed_tutorial_tpu.ops._backend import (
+            default_interpret,
+        )
+
+        interpret = default_interpret()
+    if pltpu is None:  # pragma: no cover - TPU-less builds without pltpu
+        return _reference(
+            q, key_pages, value_pages, page_table, pos,
+            key_scale_pages, value_scale_pages,
+        )
+
+    group = hq // hkv
+    pt = page_table
+    if pages_per_slot is not None:
+        pt = pt[:, :pages_per_slot]
+    num_blocks = pt.shape[1]
+    qg = q[:, 0].reshape(b, hkv, group, d)
+
+    def q_map(bi, h, i, lens, table):
+        return bi, h, 0, 0
+
+    def kv_map(bi, h, i, lens, table):
+        # Dead iterations re-point at the last live page: an unchanged
+        # block index skips the DMA, so capacity-wide grids read
+        # live-sized bytes (and never the trash page past block 0).
+        live_last = lens[bi] // page_size
+        return table[bi, jnp.minimum(i, live_last)], 0, h, 0
+
+    def scale_map(bi, h, i, lens, table):
+        live_last = lens[bi] // page_size
+        return table[bi, jnp.minimum(i, live_last)], 0, h
+
+    q_spec = pl.BlockSpec((1, 1, group, d), q_map)
+    kv_spec = pl.BlockSpec((1, page_size, 1, d), kv_map)
+    in_specs = [q_spec, kv_spec, kv_spec]
+    operands = [qg, key_pages, value_pages]
+    if quant:
+        sc_spec = pl.BlockSpec((1, page_size, 1), scale_map)
+        in_specs += [sc_spec, sc_spec]
+        operands += [key_scale_pages, value_scale_pages]
+    out_dtype = q.dtype if quant else value_pages.dtype
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, num_blocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, group, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        partial(_decode_kernel, page_size, num_blocks, d**-0.5, quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, group, d), out_dtype),
+        interpret=interpret,
+    )(pos.astype(jnp.int32), pt.astype(jnp.int32), *operands)
+    return out.reshape(b, 1, hq, d)
+
+
+def _reference(
+    q, key_pages, value_pages, page_table, pos, key_scale_pages,
+    value_scale_pages,
+):  # pragma: no cover - TPU-less builds without pltpu
+    """Gather+einsum fallback for builds where pltpu itself is absent."""
+    if key_scale_pages is not None:
+        from cs744_pytorch_distributed_tutorial_tpu.ops.quant import (
+            paged_decode_attention_quant,
+        )
+
+        return paged_decode_attention_quant(
+            q, key_pages, value_pages, key_scale_pages, value_scale_pages,
+            page_table, pos,
+        )
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
+        paged_decode_attention,
+    )
+
+    return paged_decode_attention(q, key_pages, value_pages, page_table, pos)

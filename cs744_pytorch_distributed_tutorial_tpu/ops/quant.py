@@ -320,7 +320,12 @@ def paged_decode_attention_quant(
     int8 pools with per-row scale pools ``[num_pages, page_size, Hkv]``;
     ``page_table`` ``[B, P]`` and per-slot depths ``pos`` ``[B]`` as in
     the float variant. Gather first, then the exact int8 decode path —
-    parity with the dense int8 cache is structural."""
+    parity with the dense int8 cache is structural.
+
+    Reference implementation: the four-pool gather reads capacity-many
+    pages per step. The serving hot path dequantizes inside the Pallas
+    kernel instead — ``ops/paged_attention.py::paged_attention`` with
+    ``key/value_scale_pages`` passed — reading only live pages."""
     from cs744_pytorch_distributed_tutorial_tpu.parallel.ring_attention import (
         gather_pages,
     )

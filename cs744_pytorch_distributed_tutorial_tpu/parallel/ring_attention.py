@@ -162,7 +162,14 @@ def paged_decode_attention(
     slot's pages in sequence order and ``pos`` ``[B]`` the slots'
     current depths. The gather produces the dense per-slot view and the
     masking/softmax/PV path is literally ``decode_attention`` — paged
-    parity is structural, not approximate."""
+    parity is structural, not approximate.
+
+    This is the REFERENCE implementation: its HBM traffic scales with
+    page capacity ``P``, not live length. The serving hot path is
+    ``ops/paged_attention.py::paged_attention`` — a Pallas kernel with
+    the same signature that reads only live pages straight from the
+    pool (no gather, no dense intermediate) and is tolerance-tested
+    against this function."""
     gk = gather_pages(key_pages, page_table)
     gv = gather_pages(value_pages, page_table)
     return decode_attention(q, gk, gv, pos)
@@ -306,7 +313,15 @@ def ring_flash_attention(
 def _rfa_hop_case(k_blk, idx, causal, diag_fn, lower_fn, masked_fn):
     """Dispatch one ring hop to its visibility case (traced selector)."""
     if not causal:
-        return lower_fn(None)
+        # Every hop is fully visible, but still route through a
+        # (degenerate, always-true) lax.cond: calling lower_fn directly
+        # makes the pallas_call a plain call-site inside the custom_vjp
+        # body, which the CPU SPMD partitioner lowers via PartitionId
+        # and rejects ("UNIMPLEMENTED: PartitionId") under
+        # jit(shard_map) in interpret mode. Inside a cond branch it
+        # partitions like the causal path (which always worked) — same
+        # trace shape, no runtime branch taken but the masked one.
+        return lax.cond(k_blk >= 0, lower_fn, masked_fn, None)
     return lax.cond(
         k_blk == idx,
         diag_fn,

@@ -28,16 +28,28 @@ padding. This engine serves at REQUEST granularity instead:
   Admission guarantees any single request fits the pool alone, so the
   oldest request always completes — no deadlock.
 
-Paged decode is BITWISE-identical to the dense-cache path: the gathered
-page view reproduces the cache layout exactly and runs the same
-``decode_attention`` einsum (see ``parallel/ring_attention.py::
+Decode attention has two implementations (``paged_attention_impl``):
+the "gather" reference is BITWISE-identical to the dense-cache path (the
+gathered page view reproduces the cache layout exactly and runs the same
+``decode_attention`` einsum — ``parallel/ring_attention.py::
 paged_decode_attention``), so greedy engine output matches
-``make_generator`` token for token.
+``make_generator`` token for token; the "kernel" path runs the Pallas
+paged-attention kernel (``ops/paged_attention.py``) that reads ONLY each
+slot's live pages straight from the pools — HBM traffic per step scales
+with live tokens instead of page capacity, at tolerance-level (online
+softmax) parity. "auto" picks the kernel on TPU backends.
+
+Sampling draws each request's token ``t`` from a per-request PRNG stream
+keyed by ``(req_id, t)`` — prefill and decode share it, so
+recompute-preemption replays a sampled victim's original tokens exactly.
+Tokens SURFACE as they decode (``on_token`` callback / ``iter_tokens``),
+not at retire; per-token surface times feed the ITL percentiles.
 
 Telemetry flows through ``obs`` sinks as ``kind:"serve"`` records
 (per-request TTFT / per-token decode latency / queue time) —
 ``benchmarks/metrics_summary.py`` renders them and ``regress.py`` gates
-them. The decode step registers as graftcheck entrypoint ``lm-serve``.
+them. The decode step registers as graftcheck entrypoints ``lm-serve``
+(gather) and ``lm-serve-paged`` (kernel).
 """
 
 from __future__ import annotations
@@ -91,6 +103,13 @@ class ServeConfig:
     eos_id: int | None = None
     pad_id: int = 0
     seed: int = 0
+    # Decode attention over the pools: "gather" materializes each slot's
+    # dense page view (reference; bitwise vs the dense cache), "kernel"
+    # runs the Pallas paged-attention kernel that reads only live pages
+    # (ops/paged_attention.py; tolerance-level parity). "auto" picks
+    # "kernel" on TPU backends and "gather" elsewhere — interpret-mode
+    # Pallas would throttle a CPU deployment for no byte savings.
+    paged_attention_impl: str = "auto"
 
 
 @dataclass
@@ -107,6 +126,13 @@ class Request:
     first_token_time: float | None = None
     done_time: float | None = None
     preemptions: int = 0
+    # Wall-clock time each output token SURFACED (streaming delivery) —
+    # one entry per produced token, monotone across preemptions (replayed
+    # recompute work produces new indices, never re-surfaces old ones).
+    # Consecutive diffs are the request's inter-token latencies, so the
+    # ITL tail (serve_itl_p99_ms, serve/loadgen.py) honestly includes
+    # preemption stalls.
+    token_times: list[float] = field(default_factory=list)
     # recompute-preemption carries prompt+generated as the new prompt;
     # these keep the ORIGINAL accounting across the re-queue.
     orig_prompt_len: int = -1
@@ -152,31 +178,46 @@ class ServingEngine:
         param_specs: Any = None,
         sink: Any = None,
         clock: Callable[[], float] = time.monotonic,
+        on_token: Callable[[Request, int], None] | None = None,
     ) -> None:
         check_decode_model(model, "serving", allow_tensor=mesh is not None)
-        if getattr(model, "scan_layers", False):
-            raise ValueError(
-                "serving does not support scan_layers models yet (the "
-                "page commit indexes per-layer subtrees); decode from an "
-                "unrolled clone — unstack_block_params converts params"
-            )
         if cfg.num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {cfg.num_slots}")
         if cfg.max_pages_per_slot < 1:
             raise ValueError(
                 f"max_pages_per_slot must be >= 1, got {cfg.max_pages_per_slot}"
             )
+        if cfg.paged_attention_impl not in ("auto", "gather", "kernel"):
+            raise ValueError(
+                "paged_attention_impl must be 'auto', 'gather' or "
+                f"'kernel', got {cfg.paged_attention_impl!r}"
+            )
+        impl = cfg.paged_attention_impl
+        if impl == "auto":
+            from cs744_pytorch_distributed_tutorial_tpu.ops._backend import (
+                TPU_PLATFORMS,
+            )
+
+            impl = (
+                "kernel" if jax.default_backend() in TPU_PLATFORMS
+                else "gather"
+            )
+        self.paged_attention_impl = impl
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
         self.param_specs = param_specs
         self.sink = sink
         self.clock = clock
+        self.on_token = on_token
         self.pool = PagePool(cfg.num_pages, cfg.page_size)
         self.model = model.clone(
-            page_size=cfg.page_size, num_pages=cfg.num_pages
+            page_size=cfg.page_size,
+            num_pages=cfg.num_pages,
+            paged_attention_impl=impl,
         )
         self.max_seq_len = model.max_seq_len
+        self._scanned = bool(getattr(model, "scan_layers", False))
 
         b, p = cfg.num_slots, cfg.max_pages_per_slot
         self._queue: deque[Request] = deque()
@@ -189,6 +230,14 @@ class ServingEngine:
         self._preemptions = 0
         self._completed: list[Request] = []
         self._base_key = jax.random.key(cfg.seed)
+        # One PRNG stream PER REQUEST, indexed by absolute output-token
+        # position: token t of request r always samples from
+        # fold_in(fold_in(root, r), t), whether it is produced by a
+        # prefill (t = tokens already produced before this admission) or
+        # a decode step. Recompute-preemption therefore REPLAYS a
+        # sampled victim's original tokens exactly — preemption is
+        # output-invariant for every temperature, not just greedy.
+        self._sample_root = jax.random.fold_in(self._base_key, 1)
         self._prefill_cache: dict[int, Any] = {}  # bucket len -> jitted fn
 
         self._pages = self._init_pages()
@@ -246,11 +295,15 @@ class ServingEngine:
         from jax.sharding import PartitionSpec as P
 
         axis = self.model.tensor_axis
+        # scan_layers stacks every pool with a leading [num_layers] axis
+        # (replicated), shifting the kv-head dim right by one.
+        lead = (None,) if self._scanned else ()
+        data_ndim = 5 if self._scanned else 4
 
         def spec(leaf):
-            return P(None, None, axis, None) if leaf.ndim == 4 else P(
-                None, None, axis
-            )
+            if leaf.ndim == data_ndim:
+                return P(*lead, None, None, axis, None)
+            return P(*lead, None, None, axis)
 
         return jax.tree.map(spec, self._pages_shape_tree())
 
@@ -273,13 +326,17 @@ class ServingEngine:
     def _build_decode_step(self):
         """ONE jitted fixed-shape step for the engine's lifetime: every
         argument is an array of static shape, so slot churn (retire /
-        refill / preempt — different page tables, lengths, actives)
-        re-runs the SAME executable. Pages are donated: XLA aliases the
-        pool buffers in place, the step allocates no new pool."""
+        refill / preempt — different page tables, lengths, actives,
+        request ids, token indices) re-runs the SAME executable. Pages
+        are donated: XLA aliases the pool buffers in place, the step
+        allocates no new pool."""
         cfg = self.cfg
         model = self.model
 
-        def step(params, pages, tokens, lengths, page_table, active, key):
+        def step(
+            params, pages, tokens, lengths, page_table, active, req_ids,
+            tok_idx, key,
+        ):
             logits, mutated = model.apply(
                 {"params": params, "pages": pages},
                 tokens[:, None],
@@ -288,13 +345,21 @@ class ServingEngine:
                 page_table=page_table,
                 mutable=["pages"],
             )
-            tok = sample_tokens(
-                logits[:, 0].astype(jnp.float32),
-                key,
-                temperature=cfg.temperature,
-                top_k=cfg.top_k,
-                top_p=cfg.top_p,
-            )
+            # Per-slot sampling keys from the (request, token-index)
+            # stream — see _sample_root. ``key`` is the constant stream
+            # root; it stays an argument so the executable is key-free.
+            keys = jax.vmap(
+                lambda r, t: jax.random.fold_in(jax.random.fold_in(key, r), t)
+            )(req_ids, tok_idx)
+            tok = jax.vmap(
+                lambda row, k: sample_tokens(
+                    row[None],
+                    k,
+                    temperature=cfg.temperature,
+                    top_k=cfg.top_k,
+                    top_p=cfg.top_p,
+                )[0]
+            )(logits[:, 0].astype(jnp.float32), keys)
             tok = jnp.where(active, tok, cfg.pad_id).astype(jnp.int32)
             return mutated["pages"], tok
 
@@ -309,7 +374,7 @@ class ServingEngine:
                 step,
                 mesh=self.mesh,
                 in_specs=(self.param_specs, page_specs, rep, rep, rep, rep,
-                          rep),
+                          rep, rep, rep),
                 out_specs=(page_specs, rep),
                 check_vma=False,
             ),
@@ -329,6 +394,7 @@ class ServingEngine:
         cfg = self.cfg
         model = self.model
         page_size = cfg.page_size
+        scanned = self._scanned
 
         def commit(pages, cache, page_row, true_len):
             idx = jnp.arange(bucket)
@@ -337,10 +403,19 @@ class ServingEngine:
             pidx = jnp.where(idx < true_len, page_row[idx // page_size], 0)
             off = idx % page_size
 
+            def put(p, c):
+                if scanned:
+                    # scan_layers stacks both collections with a leading
+                    # [num_layers] axis (one "blocks" subtree); the
+                    # scatter indices are layer-independent, so one
+                    # batched update commits every layer — no unrolling.
+                    return p.at[:, pidx, off].set(c[:, 0, :bucket])
+                return p.at[pidx, off].set(c[0, :bucket])
+
             def walk(p, c):
                 if any(k in p for k in _CACHE_TO_PAGES.values()):
                     return {
-                        pname: p[pname].at[pidx, off].set(c[cname][0, :bucket])
+                        pname: put(p[pname], c[cname])
                         for cname, pname in _CACHE_TO_PAGES.items()
                         if pname in p
                     }
@@ -495,8 +570,14 @@ class ServingEngine:
         bucket = self._bucket_for(plen)
         prompt = np.zeros((1, bucket), np.int32)
         prompt[0, :plen] = req.prompt
+        # The (request, token-index) stream — a recompute-preempted
+        # request's re-prefill samples token index ``output_tokens``
+        # (the first NOT-yet-produced one) from the same key a decode
+        # step would have used, so replay reproduces the original
+        # tokens at any temperature.
         key = jax.random.fold_in(
-            jax.random.fold_in(self._base_key, 1), req.req_id
+            jax.random.fold_in(self._sample_root, req.req_id),
+            req.output_tokens,
         )
         self._pages, first_tok = self._prefill_fn(bucket)(
             self.params,
@@ -511,6 +592,7 @@ class ServingEngine:
         if req.first_token_time is None:
             req.first_token_time = now
         req.generated.append(tok)
+        self._surface(req, tok, now)
         self._admit_seq += 1
         self._slots[slot_idx] = _Slot(
             req=req, length=plen, pages=pages, last_tok=tok,
@@ -608,15 +690,19 @@ class ServingEngine:
         tokens = np.full((cfg.num_slots,), cfg.pad_id, np.int32)
         lengths = np.zeros((cfg.num_slots,), np.int32)
         active = np.zeros((cfg.num_slots,), bool)
+        req_ids = np.zeros((cfg.num_slots,), np.int32)
+        tok_idx = np.zeros((cfg.num_slots,), np.int32)
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
             tokens[i] = slot.last_tok
             lengths[i] = slot.length
             active[i] = True
-        key = jax.random.fold_in(
-            jax.random.fold_in(self._base_key, 2), self._step_count
-        )
+            req_ids[i] = slot.req.req_id
+            # Absolute output-token index this step produces for the
+            # request — the per-request PRNG stream position (see
+            # _sample_root; replay-exact across preemptions).
+            tok_idx[i] = slot.req.output_tokens
         self._pages, toks = self._decode_step(
             self.params,
             self._pages,
@@ -624,17 +710,21 @@ class ServingEngine:
             jnp.asarray(lengths),
             jnp.asarray(self._page_table),
             jnp.asarray(active),
-            key,
+            jnp.asarray(req_ids),
+            jnp.asarray(tok_idx),
+            self._sample_root,
         )
         toks = np.asarray(toks)  # graftlint: disable=GL001 -- the scheduler NEEDS this sync: retire/refill decisions read the sampled tokens; one fetch per engine step, outside any jit
         self._step_count += 1
         self._active_slot_steps += int(active.sum())
+        now = self.clock()
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
             slot.length += 1
             slot.last_tok = int(toks[i])
             slot.req.generated.append(slot.last_tok)
+            self._surface(slot.req, slot.last_tok, now)
             if self._slot_done(slot):
                 self._retire(i)
         return self._completed[done_before:]
@@ -644,6 +734,43 @@ class ServingEngine:
         while self.busy:
             self.step()
         return self._completed
+
+    # ------------------------------------------------------- streaming
+
+    def _surface(self, req: Request, tok: int, now: float) -> None:
+        """Deliver one output token as it decodes (not at retire):
+        stamp its wall-clock surface time and fire the ``on_token``
+        callback. Called from prefill admission (the first token) and
+        from every decode step."""
+        req.token_times.append(now)
+        if self.on_token is not None:
+            self.on_token(req, tok)
+
+    def iter_tokens(self, req: Request):
+        """Stream a submitted request's output tokens, driving the
+        engine as needed: yields each token id as it surfaces and
+        returns when the request completes. Other in-flight requests
+        keep decoding in the same fixed-shape steps — streaming one
+        request costs the batch nothing.
+
+        Recompute-preemption moves produced tokens into the prompt, so
+        the surfaced stream is reconstructed as
+        ``prompt[orig_prompt_len:] + generated`` — already-yielded
+        tokens never re-surface.
+        """
+        yielded = 0
+        while True:
+            produced = req.output_tokens
+            if produced > yielded:
+                ids = list(req.prompt[req.orig_prompt_len:]) + list(
+                    req.generated
+                )
+                for tok in ids[yielded:produced]:
+                    yield int(tok)
+                yielded = produced
+            if req.done_time is not None or not self.busy:
+                return
+            self.step()
 
     # ------------------------------------------------------- reporting
 
@@ -663,11 +790,12 @@ class ServingEngine:
 # ----------------------------------------------------------- graftcheck
 
 
-def make_serve_trace_entry(**overrides):
+def make_serve_trace_entry(_impl: str = "gather", **overrides):
     """A graftcheck ``TracedStep`` around the engine's real jitted
     decode step: tiny paged transformer, the live argument shapes, the
-    donation contract on the page pools. The audits (``lm-serve``) lower
-    exactly what serving runs."""
+    donation contract on the page pools. The audits (``lm-serve`` for
+    the gather reference, ``lm-serve-paged`` for the Pallas
+    paged-attention kernel) lower exactly what serving runs."""
     from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
         TracedStep,
     )
@@ -691,7 +819,8 @@ def make_serve_trace_entry(**overrides):
         jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
     )["params"]
     cfg = ServeConfig(
-        num_slots=4, page_size=4, num_pages=17, max_pages_per_slot=8
+        num_slots=4, page_size=4, num_pages=17, max_pages_per_slot=8,
+        paged_attention_impl=_impl,
     )
     eng = ServingEngine(model, params, cfg)
     b, p = cfg.num_slots, cfg.max_pages_per_slot
@@ -702,10 +831,12 @@ def make_serve_trace_entry(**overrides):
         jnp.zeros((b,), jnp.int32),
         jnp.zeros((b, p), jnp.int32),
         jnp.ones((b,), jnp.bool_),
+        jnp.arange(b, dtype=jnp.int32),
+        jnp.zeros((b,), jnp.int32),
         jax.random.key(0),
     )
     return TracedStep(
-        name="lm-serve",
+        name="lm-serve" if _impl == "gather" else "lm-serve-paged",
         fn=eng._decode_step,
         args=args,
         axis_sizes={},
@@ -715,8 +846,17 @@ def make_serve_trace_entry(**overrides):
             "num_slots": cfg.num_slots,
             "page_size": cfg.page_size,
             "num_pages": cfg.num_pages,
+            "paged_attention_impl": eng.paged_attention_impl,
         },
     )
+
+
+def make_paged_serve_trace_entry(**overrides):
+    """``lm-serve`` with the Pallas paged-attention kernel in the decode
+    step (``paged_attention_impl="kernel"``): TA003/TA005 account the
+    kernel call and confirm no dead dense-gather ops ride along, and the
+    donation audit checks the pool aliases survive the kernel path."""
+    return make_serve_trace_entry(_impl="kernel", **overrides)
 
 
 def _register_serve_trace_entries() -> None:
@@ -726,6 +866,9 @@ def _register_serve_trace_entries() -> None:
 
     register_entrypoint(
         "lm-serve", make_serve_trace_entry, tags=("lm", "serve")
+    )
+    register_entrypoint(
+        "lm-serve-paged", make_paged_serve_trace_entry, tags=("lm", "serve")
     )
 
 

@@ -9,6 +9,10 @@ metrics the ISSUE names:
   minus the request's scheduled arrival — it INCLUDES queue time, which
   is the point (tail TTFT is where batch-at-a-time loses).
 - **per-token decode latency**: (done - first token) / (output - 1).
+- **ITL** (inter-token latency): gaps between consecutive streamed
+  token deliveries (``Request.token_times``, populated by the engine's
+  per-token surfacing) — the tail a streaming client sees, including
+  prefill stalls of co-admitted requests and preemption gaps.
 - **aggregate tokens/sec**: total generated tokens / makespan (first
   arrival to last completion).
 
@@ -100,6 +104,19 @@ def _summarize(
         (r.done_time - r.first_token_time) * 1e3 / max(1, r.output_tokens - 1)
         for r in reqs
     ]
+    # Inter-token latency: gaps between consecutive SURFACED tokens of
+    # one request (streaming delivery — engine._surface). Measured, not
+    # derived from the decode mean: the tail includes prefill stalls of
+    # co-resident admissions and preemption gaps, which is what a
+    # streaming client actually experiences. The batch baseline streams
+    # nothing (token_times stays empty), so its ITL reports 0 — TTFT is
+    # its honest latency metric.
+    itls: list[float] = []
+    for r in reqs:
+        if len(r.token_times) > 1:
+            itls.extend(
+                float(d) * 1e3 for d in np.diff(np.asarray(r.token_times))
+            )
     total_tokens = sum(r.output_tokens for r in reqs)
     return {
         "kind": "serve_summary",
@@ -111,6 +128,8 @@ def _summarize(
         "ttft_p50_ms": round(_percentile(ttfts, 50), 3),
         "ttft_p99_ms": round(_percentile(ttfts, 99), 3),
         "decode_ms_per_token_p50": round(_percentile(per_tok, 50), 4),
+        "itl_p50_ms": round(_percentile(itls, 50), 4),
+        "itl_p99_ms": round(_percentile(itls, 99), 4),
         "tokens_per_sec": round(total_tokens / makespan, 2)
         if makespan > 0
         else 0.0,
@@ -198,6 +217,7 @@ def run_poisson(
         for metric, value, unit in (
             ("serve_tokens_per_sec", record["tokens_per_sec"], "tokens/sec"),
             ("serve_ttft_p99_ms", record["ttft_p99_ms"], "ms"),
+            ("serve_itl_p99_ms", record["itl_p99_ms"], "ms"),
         ):
             sink.emit({
                 "kind": "bench",

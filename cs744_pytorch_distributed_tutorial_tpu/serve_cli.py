@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="pool pages per layer (page 0 reserved as trash)")
     p.add_argument("--max-pages-per-slot", type=int, default=16,
                    help="page-table width P: caps one request's KV")
+    p.add_argument("--paged-attention-impl", default="auto",
+                   choices=("auto", "gather", "kernel"),
+                   help="decode attention: Pallas live-pages kernel or "
+                        "the gather+einsum reference (auto: kernel on "
+                        "TPU, gather elsewhere)")
     # sampling
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=None)
@@ -141,6 +146,7 @@ def main(argv: list[str] | None = None) -> None:
         top_p=args.top_p,
         eos_id=args.eos_id,
         seed=args.seed,
+        paged_attention_impl=args.paged_attention_impl,
     )
     workload = make_poisson_workload(
         num_requests=args.requests,

@@ -102,37 +102,15 @@ def test_ulysses_flash_lm_trains():
     assert l1 == pytest.approx(l2, rel=1e-5)
 
 
-@pytest.mark.parametrize(
-    "causal",
-    [
-        pytest.param(
-            False,
-            marks=pytest.mark.xfail(
-                reason=(
-                    "CPU-only lowering gap, not a math bug: the non-causal "
-                    "path calls flash_forward_lse unconditionally (no "
-                    "lax.cond hop dispatch), and the interpret-mode Pallas "
-                    "kernel's program_id lowers to an HLO PartitionId when "
-                    "inlined straight into the jit(shard_map) body, which "
-                    "the XLA CPU SPMD partitioner rejects: 'UNIMPLEMENTED: "
-                    "PartitionId instruction is not supported for SPMD "
-                    "partitioning since the meaning is ambiguous'. The "
-                    "causal=True variant wraps every kernel call in "
-                    "lax.cond branches, which keeps the kernel out of the "
-                    "partitioner's way — it passes below, so the ring "
-                    "merge math itself stays covered. Real-kernel TPU runs "
-                    "don't use interpret mode and are unaffected."
-                ),
-                raises=Exception,
-                strict=True,
-            ),
-        ),
-        True,
-    ],
-)
+@pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_matches_dense(mesh8, qkv, causal):
     """Ring rotation between chips + Pallas flash per hop, merged via
-    logsumexp — same answer as dense attention."""
+    logsumexp — same answer as dense attention.
+
+    causal=False exercises the degenerate-cond hop dispatch that keeps
+    the interpret-mode kernel partitionable on CPU (the PartitionId
+    lowering gap _rfa_hop_case documents) — it used to be a strict
+    xfail here."""
     q, k, v = qkv
     expected = np.asarray(dense_attention(q, k, v, causal=causal))
     got = _run_sharded(

@@ -17,6 +17,15 @@ Four pins, in dependency order:
    LIFO recompute preemption; every request must still complete with
    its full budget (admission guarantees the oldest always fits alone).
 
+Pins 2 and 3 run under BOTH decode-attention implementations: the
+gather+einsum reference and the Pallas paged-attention kernel
+(``paged_attention_impl="kernel"``, interpret mode on CPU — kernel-level
+parity lives in tests/test_paged_attention.py). Newer contracts ride the
+same harness: per-request PRNG streams make preemption-recompute
+output-invariant for SAMPLED requests too, tokens stream out as they
+decode (``on_token`` / ``iter_tokens``, ITL measured by the loadgen),
+and ``scan_layers`` models serve token-identically to unrolled ones.
+
 Plus the host-side units (PagePool), the load generator's determinism
 and telemetry, and the regress.py budget gate the CI serve-smoke job
 relies on.
@@ -212,12 +221,14 @@ def _reference_tokens(model, params, prompt, budget):
     )[0].tolist()
 
 
-def test_engine_greedy_matches_make_generator(tiny_lm):
+@pytest.mark.parametrize("impl", ["gather", "kernel"])
+def test_engine_greedy_matches_make_generator(tiny_lm, impl):
     """Request-level output == batch generator output, token for token,
-    across different prompt lengths, budgets, and admission order."""
+    across different prompt lengths, budgets, and admission order —
+    under both decode-attention implementations."""
     model, params = tiny_lm
     cfg = ServeConfig(num_slots=2, page_size=4, num_pages=33,
-                      max_pages_per_slot=8)
+                      max_pages_per_slot=8, paged_attention_impl=impl)
     eng = ServingEngine(model, params, cfg)
     rng = np.random.default_rng(7)
     cases = [(3, 9), (7, 4), (12, 11), (5, 17), (9, 6)]
@@ -237,16 +248,19 @@ def test_engine_greedy_matches_make_generator(tiny_lm):
         assert r.generated == expect, (r.req_id, r.generated, expect)
 
 
-def test_engine_zero_retraces_across_slot_churn(tiny_lm):
+@pytest.mark.parametrize("impl", ["gather", "kernel"])
+def test_engine_zero_retraces_across_slot_churn(tiny_lm, impl):
     """The fixed-shape decode step never recompiles once warm, no
-    matter how membership churns (the GL002 contract, measured)."""
+    matter how membership churns (the GL002 contract, measured) — the
+    Pallas kernel keeps the invariant because live length enters via
+    the grid mask, never the shape."""
     from cs744_pytorch_distributed_tutorial_tpu.obs.system import (
         CompileCounter,
     )
 
     model, params = tiny_lm
     cfg = ServeConfig(num_slots=3, page_size=4, num_pages=33,
-                      max_pages_per_slot=8)
+                      max_pages_per_slot=8, paged_attention_impl=impl)
     eng = ServingEngine(model, params, cfg)
     rng = np.random.default_rng(11)
 
@@ -355,10 +369,109 @@ def test_engine_submit_validation(tiny_lm):
         eng.submit(Request(prompt=np.ones((20,), np.int32), max_new_tokens=8))
 
 
-def test_engine_rejects_scan_layers(tiny_lm):
+def test_engine_sampled_preemption_replays_prng(tiny_lm):
+    """A preempted SAMPLED request reproduces its original tokens on
+    recompute: token t of request r always samples from the same
+    fold_in(fold_in(root, r), t) key — slot, step count, and batch
+    membership never enter the stream — so a pool-starved run with
+    preemptions emits exactly what an ample-pool run emits."""
     model, params = tiny_lm
-    with pytest.raises(ValueError, match="scan_layers"):
-        ServingEngine(model.clone(scan_layers=True), params, ServeConfig())
+    sample = dict(temperature=0.9, top_k=20, seed=3)
+    cases = [(6, 18), (10, 14), (8, 16), (5, 20), (12, 12)]
+
+    def run(cfg):
+        eng = ServingEngine(model, params, cfg)
+        rng = np.random.default_rng(13)
+        reqs = [
+            eng.submit(Request(
+                prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+                max_new_tokens=budget,
+            ))
+            for plen, budget in cases
+        ]
+        eng.run()
+        # preemption absorbs early generations into the prompt; compare
+        # the full produced streams
+        return eng, [
+            list(r.prompt[r.orig_prompt_len:]) + r.generated for r in reqs
+        ]
+
+    tight, tight_out = run(ServeConfig(
+        num_slots=3, page_size=4, num_pages=9, max_pages_per_slot=7,
+        **sample,
+    ))
+    ample, ample_out = run(ServeConfig(
+        num_slots=3, page_size=4, num_pages=33, max_pages_per_slot=8,
+        **sample,
+    ))
+    assert tight.stats()["preemptions"] > 0, "pool was not tight enough"
+    assert ample.stats()["preemptions"] == 0
+    assert tight_out == ample_out
+
+
+def test_engine_streams_tokens(tiny_lm):
+    """Tokens surface as they decode, not at retire: the on_token
+    callback sees every token in order, token_times stamps each one,
+    and iter_tokens streams a request while the rest of the batch keeps
+    decoding."""
+    model, params = tiny_lm
+    cfg = ServeConfig(num_slots=2, page_size=4, num_pages=33,
+                      max_pages_per_slot=8)
+    seen: list[tuple[int, int]] = []
+    eng = ServingEngine(
+        model, params, cfg,
+        on_token=lambda r, t: seen.append((r.req_id, t)),
+    )
+    rng = np.random.default_rng(31)
+    r0 = eng.submit(Request(
+        prompt=rng.integers(1, VOCAB, size=5).astype(np.int32),
+        max_new_tokens=8,
+    ))
+    r1 = eng.submit(Request(
+        prompt=rng.integers(1, VOCAB, size=7).astype(np.int32),
+        max_new_tokens=6,
+    ))
+    streamed = list(eng.iter_tokens(r0))
+    assert streamed == r0.generated
+    assert r0.done_time is not None
+    eng.run()
+    for r in (r0, r1):
+        assert [t for rid, t in seen if rid == r.req_id] == r.generated
+        assert len(r.token_times) == r.output_tokens
+        assert all(
+            b >= a for a, b in zip(r.token_times, r.token_times[1:])
+        )
+
+
+@pytest.mark.parametrize("impl", ["gather", "kernel"])
+def test_engine_scan_layers_matches_unrolled(tiny_lm, impl):
+    """A scan_layers model serves token-identically to the unrolled
+    reference: the prefill commit scatters KV rows for ALL scanned
+    layers at once (stacked pools, no unrolling) and decode runs the
+    stacked step."""
+    from cs744_pytorch_distributed_tutorial_tpu.models import (
+        stack_block_params,
+    )
+
+    model, params = tiny_lm
+    cfg = ServeConfig(num_slots=2, page_size=4, num_pages=33,
+                      max_pages_per_slot=8, paged_attention_impl=impl)
+    eng = ServingEngine(
+        model.clone(scan_layers=True), stack_block_params(params), cfg
+    )
+    rng = np.random.default_rng(37)
+    cases = [(5, 7), (9, 5), (3, 10)]
+    reqs = [
+        eng.submit(Request(
+            prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            max_new_tokens=budget,
+        ))
+        for plen, budget in cases
+    ]
+    eng.run()
+    for r in reqs:
+        expect = _reference_tokens(model, params, r.prompt, r.max_new_tokens)
+        assert r.generated == expect, (r.req_id, r.generated, expect)
 
 
 # ------------------------------------------------------------ loadgen
@@ -403,6 +516,9 @@ def test_run_poisson_emits_summary_and_bench_twins(tiny_lm):
     assert record["total_output_tokens"] == int(wl.max_new_tokens.sum())
     assert record["tokens_per_sec"] > 0
     assert record["ttft_p99_ms"] >= record["ttft_p50_ms"] >= 0
+    # streamed-token gaps were measured, not derived from the mean
+    assert record["itl_p99_ms"] >= record["itl_p50_ms"] >= 0
+    assert record["itl_p99_ms"] > 0
 
     serve_recs = [r for r in sink.records if r.get("kind") == "serve"]
     assert len(serve_recs) == 6  # measured requests only, no warmup
@@ -416,6 +532,7 @@ def test_run_poisson_emits_summary_and_bench_twins(tiny_lm):
     }
     assert twins["serve_tokens_per_sec"] == record["tokens_per_sec"]
     assert twins["serve_ttft_p99_ms"] == record["ttft_p99_ms"]
+    assert twins["serve_itl_p99_ms"] == record["itl_p99_ms"]
 
 
 def test_metrics_summary_renders_serve_rows(tmp_path):
@@ -431,7 +548,8 @@ def test_metrics_summary_renders_serve_rows(tmp_path):
     spec.loader.exec_module(ms)
     records = [
         {"kind": "serve_summary", "engine": "continuous", "requests": 6,
-         "ttft_p50_ms": 4.0, "ttft_p99_ms": 9.0, "tokens_per_sec": 310.0,
+         "ttft_p50_ms": 4.0, "ttft_p99_ms": 9.0, "itl_p50_ms": 2.0,
+         "itl_p99_ms": 6.0, "tokens_per_sec": 310.0,
          "page_high_water": 12, "slot_occupancy": 0.8, "preemptions": 1},
         {"kind": "serve_summary", "engine": "batch", "requests": 6,
          "ttft_p50_ms": 900.0, "ttft_p99_ms": 2900.0,
@@ -440,7 +558,9 @@ def test_metrics_summary_renders_serve_rows(tmp_path):
     summary = ms.summarize(records)
     assert set(summary["serve"]) == {"continuous", "batch"}
     assert summary["serve"]["continuous"]["tokens_per_sec"] == 310.0
+    assert summary["serve"]["continuous"]["itl_p99_ms"] == 6.0
     assert summary["serve"]["batch"]["ttft_p99_ms"] == 2900.0
+    assert summary["serve"]["batch"]["itl_p99_ms"] is None  # no streaming
 
 
 # ------------------------------------------------------- regress gate

@@ -431,7 +431,8 @@ def test_registry_unknown_name_lists_known():
 def test_builtin_entrypoints_load():
     load_builtin_entrypoints()
     names = {e.name for e in get_entrypoints()}
-    assert {"cifar", "cifar-int8", "cifar-overlap", "lm", "lm-overlap"} <= names
+    assert {"cifar", "cifar-int8", "cifar-overlap", "lm", "lm-overlap",
+            "lm-serve", "lm-serve-paged"} <= names
 
 
 def test_clean_repo_audits_green(devices):
@@ -448,6 +449,26 @@ def test_clean_repo_audits_green(devices):
     assert len(summaries) == 5
     for s in summaries:
         assert s["donation"]["donated"] == s["donation"]["aliased"]
+
+
+def test_serve_entrypoints_audit_clean(devices):
+    """Both serving decode steps — gather reference AND the Pallas
+    paged-attention kernel — audit clean over the engine's REAL jitted
+    step: TA003 finds no unexpected collectives, TA005 no dead matmuls
+    (the kernel path leaves no dead dense-gather ops behind), and the
+    page-pool donation contract stays fully aliased (4/4) with the
+    kernel in the graph."""
+    load_builtin_entrypoints()
+    entries = get_entrypoints(["lm-serve", "lm-serve-paged"])
+    findings, _suppressed, summaries, _sources, errors = run_audits(
+        entries, ALL_RULES
+    )
+    assert errors == []
+    assert findings == []
+    assert len(summaries) == 2
+    for s in summaries:
+        assert s["donation"]["donated"] == 4
+        assert s["donation"]["aliased"] == 4
 
 
 # ========================================================== suppressions
