@@ -96,8 +96,19 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
                 for k in ("requests", "ttft_p50_ms", "ttft_p99_ms",
                           "itl_p50_ms", "itl_p99_ms",
                           "tokens_per_sec", "page_high_water",
-                          "slot_occupancy", "preemptions")
+                          "slot_occupancy", "preemptions",
+                          "recovered_requests")
             }
+    # Chaos visibility (docs/reliability.md): per-request kind:"serve"
+    # lifecycle events — preemption replays and kill/resume recoveries
+    # (serve/engine.py emits one record per transition).
+    serve_events = [r for r in records if r.get("kind") == "serve"]
+    preempt_replays = sum(
+        1 for r in serve_events if r.get("event") == "preempt"
+    )
+    recovered = sum(
+        1 for r in serve_events if r.get("event") == "recovered"
+    )
     return {
         "records": len(records),
         "step_records": len(steps),
@@ -114,6 +125,8 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
         "sync_compare": sync_compare,
         "serve": serve,
+        "serve_preempt_replays": preempt_replays,
+        "serve_recovered": recovered,
     }
 
 
@@ -157,6 +170,7 @@ def main(argv: list[str] | None = None) -> int:
         rows.append(("sync exposed (ms)", summary["sync_exposed_ms"]))
     for label, row in summary["serve"].items():
         occ = row.get("slot_occupancy")
+        recovered = row.get("recovered_requests")
         rows.append((
             f"serve {label}",
             f"{_fmt(row['requests'])} reqs, TTFT p50/p99 "
@@ -165,7 +179,14 @@ def main(argv: list[str] | None = None) -> int:
             f"{_fmt(row.get('itl_p50_ms'))}/{_fmt(row.get('itl_p99_ms'))} ms, "
             f"{_fmt(row['tokens_per_sec'])} tok/s, pages hw "
             f"{_fmt(row.get('page_high_water'))}, occupancy "
-            f"{_fmt(round(occ, 3) if isinstance(occ, float) else occ)}",
+            f"{_fmt(round(occ, 3) if isinstance(occ, float) else occ)}"
+            + (f", recovered {_fmt(recovered)}" if recovered else ""),
+        ))
+    if summary["serve_preempt_replays"] or summary["serve_recovered"]:
+        rows.append((
+            "serve chaos",
+            f"{summary['serve_preempt_replays']} preemption replays, "
+            f"{summary['serve_recovered']} recovered requests",
         ))
     for wire, row in summary["sync_compare"].items():
         rows.append((

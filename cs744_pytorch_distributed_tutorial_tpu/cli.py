@@ -102,12 +102,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=None,
                    help="checkpoint every N steps (0 = only at end)")
+    p.add_argument("--snapshot-every", type=int, default=None,
+                   help="keep in-memory replicated state snapshots every N "
+                        "steps (utils/memstore.py) — restart recovery with "
+                        "zero filesystem reads (0 disables)")
+    p.add_argument("--snapshot-keep", type=int, default=None,
+                   help="in-memory snapshots retained (default 2)")
     p.add_argument("--step-timeout-s", type=float, default=None,
                    help="arm a hang watchdog per training step (utils/failure.py)")
-    p.add_argument("--hang-action", choices=["log", "abort"], default=None,
+    p.add_argument("--hang-action", choices=["log", "abort", "escalate"],
+                   default=None,
                    help="watchdog action after reporting a hang: 'log' "
-                        "(observe) or 'abort' (exit so a supervisor restarts "
-                        "the job from the newest checkpoint)")
+                        "(observe), 'abort' (exit so a supervisor restarts "
+                        "the job from the newest checkpoint), or 'escalate' "
+                        "(warn -> dump -> abort across successive expiries)")
     p.add_argument("--no-halt-on-nonfinite", dest="halt_on_nonfinite",
                    action="store_false", default=None,
                    help="keep training through NaN/inf losses instead of "
@@ -124,8 +132,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-start-step", type=int, default=None)
     p.add_argument("--profile-num-steps", type=int, default=None)
     p.add_argument("--max-restarts", type=int, default=0,
-                   help="restart from the newest checkpoint on detected "
-                        "training failures (needs --checkpoint-dir)")
+                   help="restart from the newest recoverable state on "
+                        "detected training failures (needs --checkpoint-dir "
+                        "or --snapshot-every)")
+    p.add_argument("--restart-backoff-s", type=float, default=0.0,
+                   help="exponential backoff base between restarts "
+                        "(attempt n sleeps backoff * 2^(n-1), capped 60s)")
     # init_process mirror (master/part2a/part2a.py:80-85)
     p.add_argument("--coordinator", dest="coordinator_address", default=None,
                    help="coordinator address host:port (the --master-ip analog)")
@@ -182,6 +194,8 @@ _ARG_TO_FIELD = {
     "debug_sync_check": "debug_sync_check",
     "checkpoint_dir": "checkpoint_dir",
     "checkpoint_every": "checkpoint_every",
+    "snapshot_every": "snapshot_every",
+    "snapshot_keep": "snapshot_keep",
     "step_timeout_s": "step_timeout_s",
     "hang_action": "hang_action",
     "halt_on_nonfinite": "halt_on_nonfinite",
@@ -241,7 +255,9 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         state, history, restarts = run_with_recovery(
-            trainer, max_restarts=args.max_restarts
+            trainer,
+            max_restarts=args.max_restarts,
+            backoff_s=args.restart_backoff_s,
         )
         if restarts:
             print(f"recovered after {restarts} restart(s)")

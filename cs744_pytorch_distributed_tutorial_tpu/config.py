@@ -172,6 +172,15 @@ class TrainConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end when checkpoint_dir set
 
+    # In-memory replicated snapshots (utils/memstore.py): a second,
+    # faster recovery tier above the disk checkpointer — the last
+    # snapshot_keep committed TrainStates as host-RAM copies, so a
+    # restart after divergence/hang restores with ZERO filesystem reads.
+    # snapshot_every is the cadence in steps (0 = tier disabled); the
+    # same divergence-safe pending/certify discipline as disk saves.
+    snapshot_every: int = 0
+    snapshot_keep: int = 2
+
     # Failure detection (utils/failure.py — the reference's Gloo run just
     # hangs or dies, SURVEY §5.3). halt_on_nonfinite raises
     # NonFiniteLossError when a fetched loss is NaN/inf (checked at
@@ -179,13 +188,16 @@ class TrainConfig:
     # host-side watchdog that logs + dumps stacks if a step hangs (the
     # first executed batch is exempt: it blocks on XLA compilation, which
     # the timing window likewise excludes). hang_action picks what the
-    # watchdog does after reporting: "log" (observe only) or "abort"
+    # watchdog does after reporting: "log" (observe only), "abort"
     # (os._exit so a supervisor — the coordination service, k8s, a shell
     # loop — restarts the process; a wedged device fetch cannot be
-    # unblocked from within the process).
+    # unblocked from within the process), or "escalate" (graduated:
+    # first expiry warns, second adds the stack/ring/flight post-mortem,
+    # third aborts — transient stalls get a chance to clear before the
+    # process is killed).
     halt_on_nonfinite: bool = True
     step_timeout_s: float | None = None
-    hang_action: str = "log"  # "log" | "abort"
+    hang_action: str = "log"  # "log" | "abort" | "escalate"
 
     # Profiler capture (utils/profiling.py — SURVEY §5.1): when
     # profile_dir is set, fit() records an XLA device trace of

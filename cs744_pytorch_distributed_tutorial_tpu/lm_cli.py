@@ -177,6 +177,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "LM loop fetches every step already)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--snapshot-every", type=int, default=0,
+                   help="keep in-memory replicated state snapshots every N "
+                        "steps (utils/memstore.py) — restart recovery with "
+                        "zero filesystem reads (0 disables)")
+    p.add_argument("--snapshot-keep", type=int, default=2,
+                   help="in-memory snapshots retained (default 2)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="restart from the newest recoverable state on "
+                        "detected training failures (needs --checkpoint-dir "
+                        "or --snapshot-every)")
+    p.add_argument("--restart-backoff-s", type=float, default=0.0,
+                   help="exponential backoff base between restarts "
+                        "(attempt n sleeps backoff * 2^(n-1), capped 60s)")
     # data
     p.add_argument("--text-file", default=None,
                    help="byte-level corpus from a local file (vocab 256); "
@@ -533,6 +546,8 @@ def main(argv: list[str] | None = None) -> int:
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        snapshot_every=args.snapshot_every,
+        snapshot_keep=args.snapshot_keep,
         halt_on_nonfinite=args.halt_on_nonfinite,
         metrics_dir=args.metrics_dir,
         metrics_every=1 if args.metrics_every is None else args.metrics_every,
@@ -542,7 +557,22 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     trainer = LMTrainer(cfg)
-    params, _, losses = trainer.fit(tokens, steps=args.steps)
+    if args.max_restarts > 0:
+        from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+            run_with_recovery,
+        )
+
+        params, _, losses, restarts = run_with_recovery(
+            trainer,
+            max_restarts=args.max_restarts,
+            backoff_s=args.restart_backoff_s,
+            fit_args=(tokens,),
+            fit_kwargs={"steps": args.steps},
+        )
+        if restarts:
+            print(f"recovered after {restarts} restart(s)")
+    else:
+        params, _, losses = trainer.fit(tokens, steps=args.steps)
     for i, loss in enumerate(losses):
         if i % args.log_every == 0 or i == len(losses) - 1:
             print(f"{i} loss:  {loss:f}")

@@ -4,6 +4,10 @@ TPU-native replacement for the reference's torch.distributed/Gloo layer
 (SURVEY §2.2, §5.8).
 """
 
+from cs744_pytorch_distributed_tutorial_tpu.parallel.elastic import (
+    default_remesh,
+    surviving_mesh,
+)
 from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -30,7 +34,9 @@ __all__ = [
     "PipelineLMConfig",
     "PipelineLMTrainer",
     "batch_sharding",
+    "default_remesh",
     "initialize",
+    "surviving_mesh",
     "make_mesh",
     "replicated",
     "spmd_pipeline",

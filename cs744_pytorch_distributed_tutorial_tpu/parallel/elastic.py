@@ -1,0 +1,120 @@
+"""Re-mesh-on-failure: rebuild the world from the surviving devices.
+
+The reference hardcodes its world as ``[0, 1, 2, 3]``
+(``master/part2a/part2a.py:32``) — lose a rank and the job is dead.
+Here a device loss shrinks the DATA axis: ``surviving_mesh`` rebuilds
+the mesh over the devices that are still alive, and ``default_remesh``
+constructs a replacement trainer on it, carrying the in-memory snapshot
+tier (``utils/memstore.py``) across so the next ``fit`` restores with
+zero filesystem reads.
+
+Resharding onto the smaller world is deterministic and exact, because
+every piece already speaks the mesh-elastic restore discipline
+(``utils/checkpoint.py::adapt_and_place``):
+
+- replicated params redistribute via the template's shardings;
+- per-replica BN stats (leading ``[num_devices, ...]`` axis) slice down
+  to the survivors;
+- zero1/fsdp flat chunked optimizer shards re-chunk through the engines'
+  ``adapt`` hooks (``parallel/zero.py::make_elastic_adapt``) — gather to
+  the unsharded flat vector, re-split into the new world's chunk sizes;
+- the data-sampler offset is a pure function of (seed, resumed step), so
+  the resumed run consumes exactly the batches the interrupted run never
+  applied, at the new world's batch layout.
+
+Only the DATA axis is elastic: seq/tensor parallelism fix the per-shard
+*program* (head counts, sequence blocks), so losing a device from those
+axes requires a topology decision the operator must make — we fail
+loudly instead of guessing.
+
+``run_with_recovery`` calls ``default_remesh`` (via its ``remesh``
+hook) when a ``DeviceLossError`` surfaces; the chaos harness
+(``utils/chaos.py``) injects exactly that. See docs/reliability.md.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+from jax.sharding import Mesh
+
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+)
+from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
+
+
+def surviving_mesh(mesh: Mesh, lost: Any = ()) -> Mesh:
+    """Rebuild ``mesh`` without the devices whose ids are in ``lost``,
+    shrinking the DATA axis to fit.
+
+    Non-data axes keep their extent (their size divides the survivor
+    count or this raises): shrinking seq/tensor would change the
+    per-shard program, not just the batch layout. The survivor order
+    preserves the original mesh order, so which data-shard lands on
+    which device is deterministic given the lost set."""
+    lost_ids = {int(i) for i in lost}
+    survivors = [d for d in mesh.devices.flatten() if d.id not in lost_ids]
+    if not survivors:
+        raise ValueError(f"no devices survive (lost {sorted(lost_ids)})")
+    axes = dict(mesh.shape)
+    if DATA_AXIS not in axes:
+        raise ValueError(
+            f"mesh has no {DATA_AXIS!r} axis to shrink (axes {list(axes)})"
+        )
+    other = math.prod(s for name, s in axes.items() if name != DATA_AXIS)
+    new_data = len(survivors) // other
+    if new_data < 1 or len(survivors) % other:
+        raise ValueError(
+            f"{len(survivors)} surviving devices cannot fill the non-data "
+            f"axes (need a multiple of {other}); shrink seq/tensor "
+            "parallelism explicitly"
+        )
+    axes[DATA_AXIS] = new_data
+    return make_mesh(axes, devices=survivors)
+
+
+def default_remesh(trainer: Any, failure: Any) -> Any:
+    """Build a replacement trainer on the surviving mesh — the
+    ``remesh`` hook for ``run_with_recovery``.
+
+    ``failure.lost`` names the dead device ids (empty means "trust the
+    runtime": every device still visible to JAX survives). The new
+    trainer keeps the old config except for the world-size field
+    (``num_devices`` / ``data_parallel``) and inherits the old trainer's
+    ``memstore``, so the first ``fit`` on the new world restores the
+    newest in-memory snapshot, elastically resharded, with zero
+    filesystem reads."""
+    log = get_logger()
+    lost = tuple(getattr(failure, "lost", ()) or ())
+    if lost:
+        new_mesh = surviving_mesh(trainer.mesh, lost)
+    else:
+        alive = {d.id for d in jax.devices()}
+        dead = [d.id for d in trainer.mesh.devices.flatten() if d.id not in alive]
+        new_mesh = surviving_mesh(trainer.mesh, dead)
+    new_world = int(new_mesh.devices.size)
+    old_world = int(trainer.mesh.devices.size)
+    log.warning(
+        "re-meshing %d -> %d devices (lost %s)", old_world, new_world, list(lost)
+    )
+
+    memstore = getattr(trainer, "memstore", None)
+    from cs744_pytorch_distributed_tutorial_tpu.train.engine import Trainer
+    from cs744_pytorch_distributed_tutorial_tpu.train.lm import LMTrainer
+
+    if isinstance(trainer, Trainer):
+        cfg = trainer.cfg.replace(num_devices=new_world)
+        return Trainer(cfg, mesh=new_mesh, memstore=memstore)
+    if isinstance(trainer, LMTrainer):
+        cfg = trainer.cfg.replace(
+            data_parallel=new_mesh.shape[DATA_AXIS]
+        )
+        return LMTrainer(cfg, mesh=new_mesh, memstore=memstore)
+    raise TypeError(
+        f"default_remesh does not know how to rebuild {type(trainer).__name__}; "
+        "pass a custom remesh hook to run_with_recovery"
+    )

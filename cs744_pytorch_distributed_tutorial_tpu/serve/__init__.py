@@ -11,6 +11,7 @@ the ``obs`` sinks. See docs/serving.md.
 from cs744_pytorch_distributed_tutorial_tpu.serve.engine import (  # noqa: F401
     Request,
     ServeConfig,
+    ServeSnapshot,
     ServingEngine,
 )
 from cs744_pytorch_distributed_tutorial_tpu.serve.loadgen import (  # noqa: F401

@@ -153,6 +153,26 @@ class _Slot:
     admit_seq: int  # global admission counter — LIFO preemption order
 
 
+@dataclass
+class ServeSnapshot:
+    """Recoverable image of an engine's request state (not its KV).
+
+    KV pages are deliberately NOT captured: the recompute-preemption
+    path already rebuilds any slot's KV from prompt+generated, and the
+    per-request PRNG streams (keyed by request id and ABSOLUTE output
+    token index) make that rebuild output-invariant. So a snapshot is
+    just the requests — in-flight ones recorded with the preemption
+    transform pre-applied (produced tokens folded into the prompt) —
+    plus the PRNG seed and the id counter. ``resume`` on a fresh engine
+    replays every in-flight request token-for-token identically, greedy
+    or sampled (tests/test_serve_recovery.py pins both).
+    """
+
+    seed: int
+    next_id: int
+    requests: list[dict[str, Any]] = field(default_factory=list)
+
+
 class ServingEngine:
     """In-flight batching loop over ``cfg.num_slots`` decode slots.
 
@@ -228,6 +248,7 @@ class ServingEngine:
         self._step_count = 0
         self._active_slot_steps = 0
         self._preemptions = 0
+        self._recovered = 0  # requests resumed from a ServeSnapshot
         self._completed: list[Request] = []
         self._base_key = jax.random.key(cfg.seed)
         # One PRNG stream PER REQUEST, indexed by absolute output-token
@@ -534,6 +555,15 @@ class ServingEngine:
         req = slot.req
         req.preemptions += 1
         self._preemptions += 1
+        replayed = len(req.generated)
+        if self.sink is not None:
+            self.sink.emit({
+                "kind": "serve",
+                "event": "preempt",
+                "time": time.time(),
+                "id": req.req_id,
+                "replayed_tokens": replayed,
+            })
         # prompt + everything generated so far (minus nothing: the last
         # sampled token re-enters as prompt tail and its KV recomputes)
         req.prompt = np.concatenate(
@@ -772,6 +802,106 @@ class ServingEngine:
                 return
             self.step()
 
+    # ------------------------------------------------------- recovery
+
+    def snapshot(self) -> ServeSnapshot:
+        """Capture every unfinished request — killable-engine discipline
+        (utils/memstore.py for training; docs/reliability.md).
+
+        In-flight slots are recorded with the recompute-preemption
+        transform applied to COPIES (prompt <- prompt+generated, budget
+        reduced, generated cleared), ordered oldest-admission-first so a
+        resume re-admits in the original priority order; queued requests
+        follow verbatim. The live engine is not mutated — serving
+        continues untouched after a snapshot."""
+
+        def record(req: Request, *, in_flight: bool, replayed: int) -> dict:
+            prompt = np.asarray(req.prompt, np.int32).copy()
+            max_new = int(req.max_new_tokens)
+            if replayed:
+                prompt = np.concatenate(
+                    [prompt, np.asarray(req.generated, np.int32)]
+                )
+                max_new -= replayed
+            return {
+                "req_id": int(req.req_id),
+                "prompt": prompt,
+                "max_new_tokens": max_new,
+                "orig_prompt_len": int(req.orig_prompt_len),
+                "orig_max_new_tokens": int(req.orig_max_new_tokens),
+                "preemptions": int(req.preemptions),
+                "arrival_time": req.arrival_time,
+                "first_token_time": req.first_token_time,
+                "token_times": list(req.token_times),
+                "replayed_tokens": replayed,
+                "in_flight": in_flight,
+            }
+
+        active = sorted(
+            (s for s in self._slots if s is not None),
+            key=lambda s: s.admit_seq,
+        )
+        requests = [
+            record(s.req, in_flight=True, replayed=len(s.req.generated))
+            for s in active
+        ]
+        requests += [
+            record(r, in_flight=False, replayed=0) for r in self._queue
+        ]
+        return ServeSnapshot(
+            seed=self.cfg.seed, next_id=self._next_id, requests=requests
+        )
+
+    def resume(self, snap: ServeSnapshot) -> list[Request]:
+        """Re-submit a snapshot's requests into this (idle) engine.
+
+        The engine must share the snapshot's PRNG seed — the per-request
+        sample streams are keyed off it, and replay is only
+        token-identical on the same streams. Every in-flight request is
+        replayed through the normal recompute path: its re-prefill
+        samples output-token index ``output_tokens`` from the same
+        (req_id, index) key the dead engine's decode would have used, so
+        the resumed stream continues exactly where the kill landed.
+        Returns the reconstructed Requests in submission order."""
+        if self.busy:
+            raise RuntimeError(
+                "resume requires an idle engine: live requests would "
+                "interleave with the snapshot's admission order"
+            )
+        if snap.seed != self.cfg.seed:
+            raise ValueError(
+                f"snapshot was taken under seed {snap.seed}, engine has "
+                f"{self.cfg.seed}: per-request PRNG streams differ, "
+                "replay would not be token-identical"
+            )
+        out = []
+        for rec in snap.requests:
+            req = Request(
+                prompt=np.asarray(rec["prompt"], np.int32),
+                max_new_tokens=int(rec["max_new_tokens"]),
+                req_id=int(rec["req_id"]),
+                arrival_time=rec["arrival_time"],
+            )
+            req.orig_prompt_len = int(rec["orig_prompt_len"])
+            req.orig_max_new_tokens = int(rec["orig_max_new_tokens"])
+            req.preemptions = int(rec["preemptions"])
+            req.first_token_time = rec["first_token_time"]
+            req.token_times = list(rec["token_times"])
+            self.submit(req)
+            if rec["in_flight"]:
+                self._recovered += 1
+                if self.sink is not None:
+                    self.sink.emit({
+                        "kind": "serve",
+                        "event": "recovered",
+                        "time": time.time(),
+                        "id": req.req_id,
+                        "replayed_tokens": int(rec["replayed_tokens"]),
+                    })
+            out.append(req)
+        self._next_id = max(self._next_id, int(snap.next_id))
+        return out
+
     # ------------------------------------------------------- reporting
 
     def stats(self) -> dict[str, Any]:
@@ -784,6 +914,7 @@ class ServingEngine:
             "page_high_water": self.pool.high_water,
             "pages_allocatable": self.cfg.num_pages - 1,
             "preemptions": self._preemptions,
+            "recovered_requests": self._recovered,
         }
 
 

@@ -218,6 +218,13 @@ def run_poisson(
             ("serve_tokens_per_sec", record["tokens_per_sec"], "tokens/sec"),
             ("serve_ttft_p99_ms", record["ttft_p99_ms"], "ms"),
             ("serve_itl_p99_ms", record["itl_p99_ms"], "ms"),
+            # chaos visibility: requests replayed from a ServeSnapshot
+            # after a kill/resume (docs/reliability.md) — 0 on clean runs
+            (
+                "serve_recovered",
+                record.get("recovered_requests", 0),
+                "requests",
+            ),
         ):
             sink.emit({
                 "kind": "bench",

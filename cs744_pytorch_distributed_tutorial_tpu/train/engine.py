@@ -109,12 +109,23 @@ def _smoothed_xent(logits, labels, smoothing: float):
 class Trainer:
     """One engine, pluggable sync strategies (SURVEY §7 design stance)."""
 
-    def __init__(self, cfg: TrainConfig, mesh=None):
+    def __init__(self, cfg: TrainConfig, mesh=None, memstore=None):
         self.cfg = cfg
         if mesh is None:
             axes = cfg.mesh_axes or {DATA_AXIS: cfg.num_devices or len(jax.devices())}
             mesh = make_mesh(axes)
         self.mesh = mesh
+        # In-memory snapshot tier (utils/memstore.py): passed in by
+        # parallel/elastic.py::default_remesh so the snapshots survive a
+        # re-mesh, else built from cfg. fit() arbitrates restore tiers
+        # by step: newest wins, memory on ties (zero filesystem reads).
+        if memstore is None and cfg.snapshot_every:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.memstore import (
+                ReplicatedSnapshot,
+            )
+
+            memstore = ReplicatedSnapshot(max_to_keep=cfg.snapshot_keep)
+        self.memstore = memstore
         self.axis_size = mesh.shape[DATA_AXIS]
         if cfg.sync == "none" and self.axis_size > 1:
             raise ValueError(
@@ -365,9 +376,10 @@ class Trainer:
             # explicitly inside each microbatch, never via AD-inserted
             # collectives.
             self._check_vma = False
-        if cfg.hang_action not in ("log", "abort"):
+        if cfg.hang_action not in ("log", "abort", "escalate"):
             raise ValueError(
-                f"unknown hang_action {cfg.hang_action!r}; choose 'log' or 'abort'"
+                f"unknown hang_action {cfg.hang_action!r}; choose 'log', "
+                "'abort', or 'escalate'"
             )
         self.sync_monitor = None
         if cfg.debug_sync_check and self._fsdp:
@@ -921,6 +933,7 @@ class Trainer:
         history: dict[str, Any] = {"train_loss": [], "eval": [], "avg_batch_time": None}
         timer = StepTimer(window=cfg.timing_batches)
         ckpt = None
+        mem = self.memstore
         start_epoch = 0
         steps_done = 0
         steps_per_epoch = len(train_loader)
@@ -930,16 +943,28 @@ class Trainer:
             )
 
             ckpt = Checkpointer(cfg.checkpoint_dir)
-            restored = ckpt.restore_latest(state)
-            if restored is not None:
-                state = self.place_state(restored)
-                steps_done = int(jax.device_get(state.step))
-                start_epoch = steps_done // max(steps_per_epoch, 1)
-                self.log.info(
-                    "restored checkpoint at step %d (resuming at epoch %d)",
-                    steps_done,
-                    start_epoch,
-                )
+        # Restore-tier arbitration: the newest recoverable state wins;
+        # the in-memory snapshot (zero filesystem reads) wins ties with
+        # the disk tier — after a restart the two are usually the same
+        # step, and host RAM is the one that costs nothing to read.
+        mem_step = mem.latest_step() if mem is not None else None
+        disk_step = ckpt.latest_step() if ckpt is not None else None
+        restored = source = None
+        if mem_step is not None and (disk_step is None or disk_step <= mem_step):
+            restored, source = mem.restore_latest(state), "memory"
+        elif disk_step is not None:
+            restored, source = ckpt.restore_latest(state), "disk"
+        if restored is not None:
+            state = self.place_state(restored)
+            steps_done = int(jax.device_get(state.step))
+            start_epoch = steps_done // max(steps_per_epoch, 1)
+            telemetry.emit_event("restore", source=source, step=steps_done)
+            self.log.info(
+                "restored %s state at step %d (resuming at epoch %d)",
+                source,
+                steps_done,
+                start_epoch,
+            )
 
         watchdog = None
         if cfg.step_timeout_s:
@@ -948,7 +973,7 @@ class Trainer:
             )
 
             on_hang = None
-            if cfg.hang_action == "abort":
+            if cfg.hang_action in ("abort", "escalate"):
                 import os
 
                 # A wedged device fetch can't be unblocked from inside the
@@ -960,12 +985,19 @@ class Trainer:
 
             # The watchdog gets the telemetry ring (WHAT the run was
             # converging toward) and the flight recorder (what the STEP
-            # TIMES were doing): both flush on firing.
+            # TIMES were doing): both flush on firing. "escalate" climbs
+            # warn -> dump -> abort across successive expiries instead of
+            # the all-at-once report.
             watchdog = StepWatchdog(
                 cfg.step_timeout_s,
                 on_hang=on_hang,
                 metric_ring=telemetry.ring,
                 flight_recorder=flight,
+                escalation=(
+                    ("warn", "dump", "abort")
+                    if cfg.hang_action == "escalate"
+                    else None
+                ),
             )
         if cfg.halt_on_nonfinite:
             from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
@@ -995,11 +1027,13 @@ class Trainer:
 
         # Divergence-safe checkpointing under halt_on_nonfinite: the loss
         # fetched at step k is the forward pass over the params step k-1
-        # PRODUCED, so a due checkpoint is held as (step_count, state) and
-        # persisted only once the NEXT step's (or the epoch eval's) loss
-        # over those params comes back finite. Restart recovery therefore
-        # can never restore a state whose own forward pass diverged.
-        pending_ckpt: tuple[int, TrainState] | None = None
+        # PRODUCED, so a due checkpoint is held as (step_count, state,
+        # to_disk, to_mem) and persisted only once the NEXT step's (or
+        # the epoch eval's) loss over those params comes back finite.
+        # Restart recovery therefore can never restore a state whose own
+        # forward pass diverged — from EITHER tier: the in-memory
+        # snapshot rides the same pending/certify gate as the disk save.
+        pending_ckpt: tuple[int, TrainState, bool, bool] | None = None
 
         # The first executed batch blocks on XLA compilation (minutes for
         # large models) — exempt it from the watchdog the same way the
@@ -1098,6 +1132,11 @@ class Trainer:
                         and cfg.checkpoint_every
                         and (steps_done + 1) % cfg.checkpoint_every == 0
                     )
+                    snapshot_due = bool(
+                        mem is not None
+                        and cfg.snapshot_every
+                        and (steps_done + 1) % cfg.snapshot_every == 0
+                    )
                     if (
                         timing_active
                         or should_log
@@ -1139,7 +1178,12 @@ class Trainer:
                         if pending_ckpt is not None and steps_done == pending_ckpt[0]:
                             # this loss is the forward pass over the pending
                             # state's params — certified finite, persist it
-                            guarded_save(pending_ckpt[1])
+                            # on each tier that was due
+                            _, pstate, to_disk, to_mem = pending_ckpt
+                            if to_disk:
+                                guarded_save(pstate)
+                            if to_mem:
+                                mem.save(pstate)
                             pending_ckpt = None
                     elif watchdog is not None:
                         watchdog.disarm()
@@ -1166,7 +1210,7 @@ class Trainer:
                             telemetry.emit_event("straggler", **outlier)
                     prev_mono = now_mono
                     steps_done += 1
-                    if checkpoint_due:
+                    if checkpoint_due or snapshot_due:
                         if cfg.halt_on_nonfinite:
                             # Copy: train_step donates its input state, so
                             # holding the live object across the next step
@@ -1174,9 +1218,17 @@ class Trainer:
                             pending_ckpt = (
                                 steps_done,
                                 jax.tree.map(jnp.copy, state),
+                                checkpoint_due,
+                                snapshot_due,
                             )
                         else:
-                            guarded_save(state)
+                            if checkpoint_due:
+                                guarded_save(state)
+                            if snapshot_due:
+                                # mem.save gathers to host synchronously,
+                                # so the live (donatable) buffers are safe
+                                # to reuse the moment it returns.
+                                mem.save(state)
                 if self.sync_monitor is not None:
                     # Epoch boundary: fence in-flight debug callbacks, put
                     # the verdict on the metric stream, and fail loudly if
@@ -1213,10 +1265,16 @@ class Trainer:
                 if pending_ckpt is not None and steps_done == pending_ckpt[0]:
                     # epoch ended right after the due step: the eval loss
                     # just certified the pending (== current) state
-                    guarded_save(pending_ckpt[1])
+                    _, pstate, to_disk, to_mem = pending_ckpt
+                    if to_disk:
+                        guarded_save(pstate)
+                    if to_mem:
+                        mem.save(pstate)
                     pending_ckpt = None
             if ckpt is not None:
                 guarded_save(state, force=True)
+            if mem is not None:
+                mem.save(state)
             if (
                 cfg.profile_dir
                 and cfg.profile_num_steps

@@ -279,6 +279,14 @@ class LMConfig:
     checkpoint_dir: str | None = None
     checkpoint_every: int = 0  # steps; 0 = only at end when dir set
 
+    # In-memory replicated snapshots (utils/memstore.py): a second,
+    # faster recovery tier above the disk checkpointer — restart
+    # recovery restores from host RAM with ZERO filesystem reads, under
+    # the same divergence-safe pending/certify gate as disk saves.
+    # snapshot_every is the cadence in steps; 0 disables the tier.
+    snapshot_every: int = 0
+    snapshot_keep: int = 2
+
     # Failure detection (utils/failure.py), same contract as the CIFAR
     # engine: NaN/inf losses raise NonFiniteLossError (fit() fetches
     # every loss anyway — zero extra transfers); step_timeout_s arms a
@@ -307,7 +315,7 @@ class LMTrainer:
     """Jitted shard_map train/eval steps for ``TransformerLM`` on a
     ``{"data": d, "seq": s}`` mesh."""
 
-    def __init__(self, cfg: LMConfig, mesh=None):
+    def __init__(self, cfg: LMConfig, mesh=None, memstore=None):
         self.cfg = cfg
         if mesh is None:
             mesh = make_mesh(
@@ -318,6 +326,17 @@ class LMTrainer:
                 }
             )
         self.mesh = mesh
+        # In-memory snapshot tier (utils/memstore.py): passed in by
+        # parallel/elastic.py::default_remesh so snapshots survive a
+        # re-mesh, else built from cfg; fit() arbitrates restore tiers
+        # by step (newest wins, memory on ties — zero filesystem reads).
+        if memstore is None and cfg.snapshot_every:
+            from cs744_pytorch_distributed_tutorial_tpu.utils.memstore import (
+                ReplicatedSnapshot,
+            )
+
+            memstore = ReplicatedSnapshot(max_to_keep=cfg.snapshot_keep)
+        self.memstore = memstore
         self.data_size = mesh.shape[DATA_AXIS]
         self.seq_size = mesh.shape[SEQ_AXIS]
         self.tensor_size = mesh.shape.get(TENSOR_AXIS, 1)
@@ -1266,23 +1285,40 @@ class LMTrainer:
         params, opt_state = self.init()
         start_step = 0
         ckpt = None
+        mem = self.memstore
         if cfg.checkpoint_dir:
             from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
                 Checkpointer,
             )
 
             ckpt = Checkpointer(cfg.checkpoint_dir)
-            restored = ckpt.restore_latest(
-                LMState(jnp.zeros((), jnp.int32), params, opt_state),
-                adapt=(
-                    self._zero_elastic_adapt
-                    if self._zero1_opt is not None
-                    else None
-                ),
+        # Restore-tier arbitration (same rule as the CIFAR engine): the
+        # newest recoverable state wins; the in-memory snapshot (zero
+        # filesystem reads) wins ties with the disk tier. Both tiers
+        # pass the same ZeRO elastic adapt, so a snapshot taken at one
+        # data_parallel re-chunks onto another exactly like a disk
+        # checkpoint would.
+        restore_source = None  # emitted once telemetry exists below
+        adapt = (
+            self._zero_elastic_adapt if self._zero1_opt is not None else None
+        )
+        template = LMState(jnp.zeros((), jnp.int32), params, opt_state)
+        mem_step = mem.latest_step() if mem is not None else None
+        disk_step = ckpt.latest_step() if ckpt is not None else None
+        restored = None
+        if mem_step is not None and (disk_step is None or disk_step <= mem_step):
+            restored, restore_source = (
+                mem.restore_latest(template, adapt=adapt),
+                "memory",
             )
-            if restored is not None:
-                start_step = int(jax.device_get(restored.step))
-                params, opt_state = restored.params, restored.opt_state
+        elif disk_step is not None:
+            restored, restore_source = (
+                ckpt.restore_latest(template, adapt=adapt),
+                "disk",
+            )
+        if restored is not None:
+            start_step = int(jax.device_get(restored.step))
+            params, opt_state = restored.params, restored.opt_state
         losses: list[float] = []
         # Per-step metrics beyond the loss (MoE aux/drop when routed
         # FFNs are active) — inspect after fit() via ``self.history``.
@@ -1350,6 +1386,10 @@ class LMTrainer:
             n_params=n_params,
             grad_sync_bytes_per_step=wire_bytes,
         )
+        if restore_source is not None:
+            telemetry.emit_event(
+                "restore", source=restore_source, step=start_step
+            )
 
         # ---- flight recorder (obs/flight.py): per-step wall ring + MAD
         # straggler detection, dumped as events on watchdog fire,
@@ -1461,8 +1501,13 @@ class LMTrainer:
                     )
                     raise NonFiniteLossError(step, loss)
                 if pending_ckpt is not None:
-                    # This finite loss ran over pending_ckpt's params.
-                    ckpt.save(pending_ckpt)
+                    # This finite loss ran over pending_ckpt's params —
+                    # certified; persist on each tier that was due.
+                    pstate, to_disk, to_mem = pending_ckpt
+                    if to_disk:
+                        ckpt.save(pstate)
+                    if to_mem:
+                        mem.save(pstate)
                     pending_ckpt = None
                 losses.append(loss)
                 step_fields: dict[str, float] = {}
@@ -1479,26 +1524,41 @@ class LMTrainer:
                         grad_sync_bytes=wire_bytes,
                         **step_fields,
                     )
-                if (
+                ckpt_due = bool(
                     ckpt
                     and cfg.checkpoint_every
                     and (step + 1) % cfg.checkpoint_every == 0
-                ):
+                )
+                snap_due = bool(
+                    mem is not None
+                    and cfg.snapshot_every
+                    and (step + 1) % cfg.snapshot_every == 0
+                )
+                if ckpt_due or snap_due:
                     if cfg.halt_on_nonfinite:
                         # Copy: train_step donates its input state, so
                         # holding the live arrays across the next step
                         # would reference deleted buffers (same as the
                         # CIFAR engine's pending copy).
-                        pending_ckpt = LMState(
-                            jnp.int32(step + 1),
-                            jax.tree.map(jnp.copy, params),
-                            jax.tree.map(jnp.copy, opt_state),
+                        pending_ckpt = (
+                            LMState(
+                                jnp.int32(step + 1),
+                                jax.tree.map(jnp.copy, params),
+                                jax.tree.map(jnp.copy, opt_state),
+                            ),
+                            ckpt_due,
+                            snap_due,
                         )
                     else:
-                        ckpt.save(
-                            LMState(jnp.int32(step + 1), params, opt_state)
-                        )
-            if ckpt is not None:
+                        live = LMState(jnp.int32(step + 1), params, opt_state)
+                        if ckpt_due:
+                            ckpt.save(live)
+                        if snap_due:
+                            # mem.save gathers to host synchronously, so
+                            # the live (donatable) buffers are safe to
+                            # reuse the moment it returns.
+                            mem.save(live)
+            if ckpt is not None or mem is not None:
                 final = max(steps, start_step)
                 if cfg.halt_on_nonfinite and steps > start_step:
                     # Certify the final params with one eval forward
@@ -1506,9 +1566,11 @@ class LMTrainer:
                     f_loss = float(self.eval_step(params, x, y)["loss"])
                     if not math.isfinite(f_loss):
                         raise NonFiniteLossError(steps, f_loss)
-                ckpt.save(
-                    LMState(jnp.int32(final), params, opt_state), force=True
-                )
+                final_state = LMState(jnp.int32(final), params, opt_state)
+                if ckpt is not None:
+                    ckpt.save(final_state, force=True)
+                if mem is not None:
+                    mem.save(final_state)
         except BaseException as e:
             # Crash post-mortem: the timing tail goes onto the metric
             # stream before the run dies (KeyboardInterrupt included).

@@ -1,0 +1,220 @@
+"""Fault injection under seeded schedules — chaos-test the recovery ladder.
+
+The reference repo's failure story is "a dead rank hangs Gloo forever"
+(SURVEY §5.3); this repo's replacement (watchdog + divergence detection
++ tiered restore + re-mesh, ``utils/failure.py``) is only trustworthy if
+it is EXERCISED. This module injects the three production fault shapes
+at deterministic points in a run:
+
+- ``"nan"`` — the step executes normally, then its fetched loss is
+  poisoned to NaN (flaky-chip / bad-batch analog). ``fit`` raises
+  ``NonFiniteLossError`` at the next fetch; recovery restores the newest
+  tier and replays.
+- ``"device_loss"`` — ``DeviceLossError`` raised before the step runs
+  (chip or host dropped out). Recovery escalates to re-meshing onto the
+  surviving devices (``parallel/elastic.py``) when a ``remesh`` hook is
+  armed.
+- ``"sigterm"`` — real ``SIGTERM`` to this process (preemption notice).
+  Under ``trap_sigterm`` the signal re-enters the run as a
+  ``TrainingFailure`` so the same restart ladder handles it.
+
+Faults live in a ``FaultSchedule`` keyed by *cumulative* train-step call
+index — the counter spans restarts, so a schedule "fault at call 3"
+fires once even though recovery replays calls 0..2. Schedules are
+either explicit (``FaultSchedule({3: "nan"})``) or seeded
+(``FaultSchedule.seeded(seed, ...)``) for randomized-but-reproducible
+chaos runs. Every injection is emitted as a ``kind:"event"`` record
+(``chaos_inject``) through the obs sinks, so a chaos run's timeline —
+injections, restarts, re-meshes, recovery — is one JSONL stream.
+
+Used by tests/test_chaos.py and the chaos-smoke CI job; the operator
+story is in docs/reliability.md.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+from typing import Any
+
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+    DeviceLossError,
+    TrainingFailure,
+    emit_event,
+    run_with_recovery,
+)
+from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
+
+FAULT_KINDS = ("nan", "device_loss", "sigterm")
+
+
+class SigtermFailure(TrainingFailure):
+    """SIGTERM delivered mid-run (preemption) — recoverable by restart."""
+
+
+class FaultSchedule:
+    """Faults keyed by cumulative train-step call index.
+
+    Each entry fires exactly once (transient faults — the production
+    shape recovery can actually beat; a *persistent* fault replays
+    after every restart and correctly exhausts ``max_restarts``).
+    Values are a fault kind string or a dict like
+    ``{"kind": "device_loss", "lost": [4, 5, 6, 7]}``.
+    """
+
+    def __init__(self, faults: dict[int, str | dict[str, Any]]):
+        self.faults: dict[int, dict[str, Any]] = {}
+        for idx, spec in faults.items():
+            if isinstance(spec, str):
+                spec = {"kind": spec}
+            if spec.get("kind") not in FAULT_KINDS:
+                raise ValueError(
+                    f"fault kind must be one of {FAULT_KINDS}, got "
+                    f"{spec.get('kind')!r} at call {idx}"
+                )
+            self.faults[int(idx)] = dict(spec)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        n_calls: int,
+        rate: float = 0.1,
+        kinds: tuple[str, ...] = ("nan",),
+        first_call: int = 1,
+        lost: tuple[int, ...] = (),
+    ) -> "FaultSchedule":
+        """Randomized-but-reproducible schedule: each call index in
+        ``[first_call, n_calls)`` faults with probability ``rate``, kind
+        drawn uniformly from ``kinds``. Same seed -> same chaos, so a
+        failing chaos run replays exactly."""
+        rng = np.random.default_rng(seed)
+        faults: dict[int, dict[str, Any]] = {}
+        for idx in range(first_call, n_calls):
+            if rng.random() < rate:
+                kind = kinds[int(rng.integers(len(kinds)))]
+                spec: dict[str, Any] = {"kind": kind}
+                if kind == "device_loss" and lost:
+                    spec["lost"] = tuple(lost)
+                faults[idx] = spec
+        return cls(faults)
+
+    def pop(self, idx: int) -> dict[str, Any] | None:
+        return self.faults.pop(idx, None)
+
+    def __len__(self) -> int:
+        return len(self.faults)
+
+
+class ChaosMonkey:
+    """Wrap a trainer's ``train_step`` to fire a ``FaultSchedule``.
+
+    The call counter is owned by the monkey, not the wrapper, so it is
+    cumulative across restarts AND across re-meshes (``install`` the
+    same monkey on the replacement trainer — ``run_chaos`` does this
+    automatically). ``injected`` records ``(call_index, kind)`` for
+    assertions."""
+
+    def __init__(self, schedule: FaultSchedule, telemetry: Any = None):
+        self.schedule = schedule
+        self.telemetry = telemetry
+        self.calls = 0  # cumulative train_step invocations, all restarts
+        self.injected: list[tuple[int, str]] = []
+        self._log = get_logger()
+
+    def _inject(self, idx: int, kind: str) -> None:
+        self.injected.append((idx, kind))
+        self._log.warning("chaos: injecting %r at call %d", kind, idx)
+        emit_event(self.telemetry, "chaos_inject", fault=kind, call=idx)
+
+    def install(self, trainer: Any) -> Any:
+        """Monkeypatch ``trainer.train_step`` (works for both engines:
+        the metrics dict is the tuple's last element). Returns the
+        trainer for chaining inside a ``remesh`` hook."""
+        orig = trainer.train_step
+
+        def chaotic_step(*args, **kwargs):
+            idx = self.calls
+            self.calls += 1
+            fault = self.schedule.pop(idx)
+            kind = fault["kind"] if fault else None
+            if kind == "device_loss":
+                self._inject(idx, kind)
+                raise DeviceLossError(step=idx, lost=fault.get("lost", ()))
+            if kind == "sigterm":
+                self._inject(idx, kind)
+                # Real signal to this process: delivery is checked at
+                # the next bytecode, so under trap_sigterm this raises
+                # SigtermFailure before the step executes — exactly a
+                # preemption notice landing between steps.
+                os.kill(os.getpid(), signal.SIGTERM)
+            result = orig(*args, **kwargs)
+            if kind == "nan":
+                self._inject(idx, kind)
+                import jax.numpy as jnp
+
+                metrics = dict(result[-1], loss=jnp.float32(float("nan")))
+                result = (*result[:-1], metrics)
+            return result
+
+        trainer.train_step = chaotic_step
+        return trainer
+
+
+@contextlib.contextmanager
+def trap_sigterm():
+    """Convert SIGTERM into a catchable ``SigtermFailure`` for the scope.
+
+    Python delivers the signal on the main thread between bytecodes, so
+    the exception surfaces inside the training loop and flows into
+    ``run_with_recovery``'s ladder like any other ``TrainingFailure``.
+    The previous handler (e.g. ``obs/flight.py``'s dumping handler) is
+    restored on exit."""
+
+    def _raise(signum, frame):
+        raise SigtermFailure("SIGTERM received (preemption)")
+
+    prev = signal.signal(signal.SIGTERM, _raise)
+    try:
+        yield
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def run_chaos(
+    trainer: Any,
+    schedule: FaultSchedule | ChaosMonkey,
+    *,
+    telemetry: Any = None,
+    remesh: Any = None,
+    **recovery_kwargs: Any,
+):
+    """Install the chaos monkey, trap SIGTERM, and run the recovery
+    ladder. ``remesh`` (``parallel/elastic.py::default_remesh``) is
+    wrapped so the replacement trainer is re-instrumented — the fault
+    schedule keeps firing across the re-mesh. Returns
+    ``(*fit_result, restarts, monkey)``."""
+    monkey = (
+        schedule
+        if isinstance(schedule, ChaosMonkey)
+        else ChaosMonkey(schedule, telemetry=telemetry)
+    )
+    monkey.install(trainer)
+
+    chaotic_remesh = None
+    if remesh is not None:
+
+        def chaotic_remesh(tr, failure):
+            return monkey.install(remesh(tr, failure))
+
+    with trap_sigterm():
+        result = run_with_recovery(
+            trainer,
+            telemetry=telemetry,
+            remesh=chaotic_remesh,
+            **recovery_kwargs,
+        )
+    return (*result, monkey)

@@ -33,8 +33,97 @@ def _path_key(path) -> str:
     return "/".join(parts)
 
 
+def adapt_host_leaf(path, saved, like, adapt=None):
+    """Resize one saved leaf to the template leaf ``like``'s shape.
+
+    Same-shape leaves pass through untouched. For shape mismatches the
+    ``adapt`` hook is consulted first (``adapt(path_key, saved_host,
+    like) -> array | None`` — the ZeRO engines re-chunk flat shard state
+    here), then the default world-size rule: the leading axis slices
+    down or tiles cyclically up; any other mismatch is an error. Shared
+    by ``Checkpointer.restore_latest`` (disk) and
+    ``utils/memstore.py::ReplicatedSnapshot`` (host RAM) so both restore
+    tiers are mesh-elastic with identical semantics."""
+    if isinstance(saved, jax.Array) and not saved.is_fully_addressable:
+        if saved.shape == like.shape:
+            # Same-shape leaf already living on a process-spanning
+            # sharding: device_get would raise; the caller's
+            # place_state/host_to_global handles any re-placement.
+            return saved
+        raise ValueError(
+            "mesh-elastic adaptation of a process-spanning leaf "
+            f"(shape {saved.shape} -> {like.shape}) is not "
+            "supported: restore on a single-host mesh first, or "
+            "match the saved world size"
+        )
+    saved = np.asarray(jax.device_get(saved))
+    if saved.shape == like.shape:
+        return saved
+    if adapt is not None:
+        out = adapt(_path_key(path), saved, like)
+        if out is not None:
+            return out
+    if saved.shape[1:] != like.shape[1:] or saved.ndim == 0:
+        raise ValueError(
+            f"cannot adapt checkpoint leaf of shape {saved.shape} to "
+            f"{like.shape}: only the leading (world-size) axis may "
+            "differ"
+        )
+    n = like.shape[0]
+    if saved.shape[0] >= n:
+        return saved[:n]
+    reps = -(-n // saved.shape[0])
+    return np.tile(saved, (reps,) + (1,) * (saved.ndim - 1))[:n]
+
+
+def place_host_leaf(leaf, like):
+    """Commit one (usually host-numpy) leaf to the template leaf's
+    sharding. Leaving restored leaves uncommitted lets jit's donation
+    pairing match a donated input against a same-shaped output of a
+    DIFFERENT sharding (observed on the mixed chunked/natural ZeRO x EP
+    layout: an XLA "aliased input/output size" crash on the first
+    resumed step) — so every restore tier places through here."""
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        return leaf  # process-spanning: caller re-places
+    if isinstance(like, jax.Array):
+        if (
+            isinstance(leaf, jax.Array)
+            and leaf.committed
+            and leaf.sharding.is_equivalent_to(like.sharding, leaf.ndim)
+        ):
+            # Already a committed device array on the template's
+            # sharding: the np.asarray round-trip would pull every
+            # shard to host and re-upload for nothing, and the
+            # donation-pairing guarantee above already holds.
+            return leaf
+        return jax.device_put(np.asarray(leaf), like.sharding)
+    return leaf
+
+
+def adapt_and_place(saved_tree, template, adapt=None):
+    """Full restore discipline over a saved pytree: per-leaf elastic
+    resize (``adapt_host_leaf``) then commit to the template's shardings
+    (``place_host_leaf``). ``saved_tree`` must match ``template``'s
+    structure (host numpy or device arrays per leaf)."""
+    adapted = jax.tree_util.tree_map_with_path(
+        lambda p, s, like: adapt_host_leaf(p, s, like, adapt),
+        saved_tree,
+        template,
+    )
+    return jax.tree.map(place_host_leaf, adapted, template)
+
+
 class Checkpointer:
-    """Thin Orbax CheckpointManager wrapper keyed by training step."""
+    """Thin Orbax CheckpointManager wrapper keyed by training step.
+
+    Class-wide ``total_restores``/``total_saves`` count actual
+    filesystem restore/save operations across every instance — the
+    chaos tests (tests/test_chaos.py) read them to PROVE the in-memory
+    snapshot recovery path (``utils/memstore.py``) touched no disk.
+    """
+
+    total_restores = 0  # filesystem restores, across all instances
+    total_saves = 0  # filesystem saves, across all instances
 
     def __init__(self, directory: str, max_to_keep: int = 3):
         import orbax.checkpoint as ocp
@@ -58,9 +147,19 @@ class Checkpointer:
         step = int(jax.device_get(state.step))
         if force and self.manager.latest_step() == step:
             return  # already saved at this step
+        Checkpointer.total_saves += 1
         self.manager.save(step, args=self._ocp.args.StandardSave(state))
         if wait:
             self.manager.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        """Newest durable step, or None with no checkpoints. Fences
+        in-flight async saves first so the answer reflects what
+        ``restore_latest`` would actually load (the restore-tier
+        arbitration in the engines' ``fit`` compares this against the
+        in-memory snapshot's step)."""
+        self.manager.wait_until_finished()
+        return self.manager.latest_step()
 
     def restore_latest(self, template: Any, adapt=None) -> Any | None:
         """Restore the newest checkpoint into ``template``'s structure and
@@ -77,6 +176,7 @@ class Checkpointer:
         step = self.manager.latest_step()
         if step is None:
             return None
+        Checkpointer.total_restores += 1
         try:
             return self.manager.restore(
                 step, args=self._ocp.args.StandardRestore(template)
@@ -129,69 +229,9 @@ class Checkpointer:
         raw = self.manager.restore(
             step, args=self._ocp.args.StandardRestore(target)
         )
-
-        def adapt_leaf(path, saved, like):
-            if isinstance(saved, jax.Array) and not saved.is_fully_addressable:
-                if saved.shape == like.shape:
-                    # Same-shape leaf already living on a process-spanning
-                    # sharding: device_get would raise; the caller's
-                    # place_state/host_to_global handles any re-placement.
-                    return saved
-                raise ValueError(
-                    "mesh-elastic adaptation of a process-spanning leaf "
-                    f"(shape {saved.shape} -> {like.shape}) is not "
-                    "supported: restore on a single-host mesh first, or "
-                    "match the saved world size"
-                )
-            saved = np.asarray(jax.device_get(saved))
-            if saved.shape == like.shape:
-                return saved
-            if adapt is not None:
-                out = adapt(_path_key(path), saved, like)
-                if out is not None:
-                    return out
-            if saved.shape[1:] != like.shape[1:] or saved.ndim == 0:
-                raise ValueError(
-                    f"cannot adapt checkpoint leaf of shape {saved.shape} to "
-                    f"{like.shape}: only the leading (world-size) axis may "
-                    "differ"
-                )
-            n = like.shape[0]
-            if saved.shape[0] >= n:
-                return saved[:n]
-            reps = -(-n // saved.shape[0])
-            return np.tile(saved, (reps,) + (1,) * (saved.ndim - 1))[:n]
-
-        adapted = jax.tree_util.tree_map_with_path(adapt_leaf, raw, template)
-
-        def place(leaf, like):
-            # Adapted leaves are host numpy; commit them to the
-            # template's sharding NOW. Leaving them uncommitted lets
-            # jit's donation pairing match a donated input against a
-            # same-shaped output of a DIFFERENT sharding (observed on
-            # the mixed chunked/natural ZeRO x EP layout: an XLA
-            # "aliased input/output size" crash on the first resumed
-            # step).
-            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
-                return leaf  # process-spanning: caller re-places
-            if isinstance(like, jax.Array):
-                if (
-                    isinstance(leaf, jax.Array)
-                    and leaf.committed
-                    and leaf.sharding.is_equivalent_to(
-                        like.sharding, leaf.ndim
-                    )
-                ):
-                    # Already a committed device array on the template's
-                    # sharding (same-shape leaves Orbax restored in
-                    # place): the np.asarray round-trip would pull every
-                    # shard to host and re-upload for nothing, and the
-                    # donation-pairing guarantee above already holds.
-                    return leaf
-                return jax.device_put(np.asarray(leaf), like.sharding)
-            return leaf
-
-        return jax.tree.map(place, adapted, template)
+        # Elastic resize + commit to the template's shardings — the
+        # module-level discipline shared with ReplicatedSnapshot.
+        return adapt_and_place(raw, template, adapt)
 
     def close(self) -> None:
         self.manager.wait_until_finished()

@@ -19,10 +19,20 @@ the three pieces the reference is missing:
 2. ``NonFiniteLossError`` — divergence detection. ``Trainer.fit`` raises
    it when a fetched loss is NaN/inf (checked at logging granularity, so
    detection costs zero extra host<->device transfers).
-3. ``run_with_recovery`` — checkpoint/restart elasticity. Wraps a
-   trainer's ``fit``; on a detected failure it re-enters ``fit``, which
-   restores the newest checkpoint (``utils/checkpoint.py``) and resumes
-   from the step it recorded — up to ``max_restarts`` times.
+3. ``run_with_recovery`` — restart elasticity with a graduated
+   escalation ladder. Wraps a trainer's ``fit``; on a detected failure
+   it re-enters ``fit``, which restores the newest state tier — the
+   in-memory replicated snapshot (``utils/memstore.py``, zero
+   filesystem reads) when one is newer, else the newest disk checkpoint
+   (``utils/checkpoint.py``) — and resumes from the recorded step, up
+   to ``max_restarts`` times with exponential backoff between attempts.
+   A ``DeviceLossError`` escalates to re-meshing onto the surviving
+   devices (``parallel/elastic.py``) before the restart. Every
+   transition lands on the obs sinks as a ``kind:"event"`` record.
+
+The fault-injection harness that exercises all of this under seeded
+schedules lives in ``utils/chaos.py``; docs/reliability.md walks the
+full ladder.
 """
 
 from __future__ import annotations
@@ -51,6 +61,25 @@ class NonFiniteLossError(TrainingFailure):
         self.loss = loss
 
 
+class DeviceLossError(TrainingFailure):
+    """A device (or its host) dropped out of the mesh mid-run.
+
+    Retrying on the same mesh cannot succeed — the surviving world must
+    re-mesh (``parallel/elastic.py``). ``lost`` carries the dead device
+    ids (what the runtime's health check, or the chaos harness's seeded
+    schedule, reported); ``run_with_recovery`` hands them to its
+    ``remesh`` callback."""
+
+    def __init__(self, step: int, lost=()):
+        lost = tuple(lost)
+        super().__init__(
+            f"device loss at step {step}"
+            + (f" (lost devices {list(lost)})" if lost else "")
+        )
+        self.step = step
+        self.lost = lost
+
+
 class StepWatchdog:
     """Detect hung training steps from the host side.
 
@@ -76,8 +105,24 @@ class StepWatchdog:
     once ``disarm`` returns, no fire for that section can happen: the
     deadline check AND the report itself run under the lock, so a
     concurrent ``disarm`` either cancels the fire or blocks until the
-    report finishes.
+    report finishes. The deadline is consumed BEFORE the report, so one
+    expired section fires exactly once — re-arming during an in-flight
+    ``_fire`` (the lock is re-entrant, so even a stage callback may
+    re-arm) starts a NEW section and can never double-fire the old one.
+
+    ``escalation`` graduates successive fires instead of the all-at-once
+    legacy report: fire #n runs stage ``escalation[min(n-1, len-1)]`` —
+    ``"warn"`` logs only, ``"dump"`` adds the stack/ring/flight
+    post-mortem, ``"abort"`` additionally invokes ``on_hang`` (the
+    process-abort callback in the engines). While stages remain, an
+    expired section re-arms itself for another ``timeout_s`` — a
+    persistently wedged step climbs the whole ladder with no help from
+    the (blocked) training thread, and ``disarm`` still cancels at any
+    rung. ``None`` keeps the legacy behavior: every fire warns, dumps,
+    and calls ``on_hang``, exactly once per section.
     """
+
+    STAGES = ("warn", "dump", "abort")
 
     def __init__(
         self,
@@ -87,7 +132,18 @@ class StepWatchdog:
         metric_ring: Any | None = None,
         ring_tail: int = 32,
         flight_recorder: Any | None = None,
+        escalation: tuple[str, ...] | None = None,
     ):
+        if escalation is not None:
+            escalation = tuple(escalation)
+            bad = [s for s in escalation if s not in self.STAGES]
+            if bad or not escalation:
+                raise ValueError(
+                    f"escalation stages must be drawn from {self.STAGES}, "
+                    f"got {escalation!r}"
+                )
+        self.escalation = escalation
+        self.last_stage: str | None = None  # stage of the newest fire
         self.timeout_s = timeout_s
         self.on_hang = on_hang
         self.dump_stacks = dump_stacks
@@ -105,7 +161,10 @@ class StepWatchdog:
         self.flight_recorder = flight_recorder
         self.fired = 0  # total hang detections (for tests/metrics)
         self._log = get_logger()
-        self._cv = threading.Condition()
+        # Re-entrant lock: a stage callback (which runs inside _fire,
+        # under the lock, on the monitor thread) may legitimately
+        # re-arm for the next section without deadlocking.
+        self._cv = threading.Condition(threading.RLock())
         self._deadline: float | None = None  # None = disarmed
         self._armed_timeout = timeout_s
         self._closed = False
@@ -165,16 +224,43 @@ class StepWatchdog:
                 elapsed = self._armed_timeout + (now - self._deadline)
                 self._deadline = None
                 self._fire(elapsed, self._armed_timeout)
+                if (
+                    self.escalation is not None
+                    and self.fired < len(self.escalation)
+                    and self._deadline is None
+                    and not self._closed
+                ):
+                    # Ladder continuation: the hung thread cannot re-arm,
+                    # so a still-wedged section escalates on its own —
+                    # next stage after another timeout_s. disarm() (the
+                    # section completed after all) cancels as usual; a
+                    # stage callback that re-armed keeps ITS deadline.
+                    self._deadline = (
+                        time.monotonic() + self._armed_timeout
+                    )
 
     def _fire(self, elapsed_s: float, timeout_s: float) -> None:
         self.fired += 1
+        if self.escalation is None:
+            stage = None  # legacy: warn + dump + callback, every fire
+        else:
+            stage = self.escalation[
+                min(self.fired - 1, len(self.escalation) - 1)
+            ]
+        self.last_stage = stage
+        do_dump = stage in (None, "dump", "abort")
+        do_callback = stage in (None, "abort")
         self._log.critical(
             "watchdog: training step exceeded %.1fs (%.1fs elapsed) — host is "
-            "likely blocked on a device transfer behind a hung collective; "
-            "dumping stacks",
+            "likely blocked on a device transfer behind a hung collective"
+            "%s",
             timeout_s,
             elapsed_s,
+            "; dumping stacks" if do_dump else
+            f" (escalation stage {stage!r}, fire #{self.fired})",
         )
+        if not do_dump:
+            return
         if self.dump_stacks:
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
         if self.metric_ring is not None:
@@ -196,8 +282,23 @@ class StepWatchdog:
                 )
             except Exception as e:  # never let telemetry break the report
                 self._log.critical("watchdog: flight recorder dump failed: %r", e)
-        if self.on_hang is not None:
+        if do_callback and self.on_hang is not None:
             self.on_hang(elapsed_s)
+
+
+def emit_event(target: Any, event: str, **fields: Any) -> None:
+    """Put one ``kind:"event"`` record on ``target``: either a
+    ``Telemetry`` (``obs/metrics.py``, has ``emit_event``) or a raw sink
+    (``obs/sinks.py``, has ``emit``). None is a no-op — recovery never
+    depends on telemetry being configured."""
+    if target is None:
+        return
+    if hasattr(target, "emit_event"):
+        target.emit_event(event, **fields)
+    else:
+        target.emit(
+            {"kind": "event", "event": event, "time": time.time(), **fields}
+        )
 
 
 def run_with_recovery(
@@ -206,16 +307,46 @@ def run_with_recovery(
     max_restarts: int = 2,
     fit_args: tuple = (),
     fit_kwargs: dict[str, Any] | None = None,
+    backoff_s: float = 0.0,
+    backoff_factor: float = 2.0,
+    max_backoff_s: float = 60.0,
+    sleep: Callable[[float], None] = time.sleep,
+    telemetry: Any = None,
+    remesh: Callable[[Any, TrainingFailure], Any] | None = None,
 ):
-    """Run ``trainer.fit`` with checkpoint/restart recovery.
+    """Run ``trainer.fit`` with restart recovery and a graduated
+    escalation ladder.
 
     On a ``TrainingFailure`` (e.g. ``NonFiniteLossError``) the run is
-    restarted: ``fit`` restores the newest checkpoint for its
-    ``checkpoint_dir`` and resumes at the recorded step, so work since
-    the last checkpoint — including the steps that produced the
-    divergence — is replayed from known-good state. Requires
-    ``trainer.cfg.checkpoint_dir`` (without it there is nothing to
-    restart FROM, and the failure re-raises immediately).
+    restarted: ``fit`` restores the newest recoverable state and resumes
+    at the recorded step, so work since that state — including the steps
+    that produced the divergence — is replayed from known-good state.
+    The restore tier is ``fit``'s arbitration: the in-memory replicated
+    snapshot (``trainer.memstore``, zero filesystem reads) when it is at
+    least as new as the newest disk checkpoint, else the disk
+    checkpoint. Requires at least one tier —
+    ``trainer.cfg.checkpoint_dir`` or a ``trainer.memstore`` (without
+    either there is nothing to restart FROM, and the failure re-raises
+    immediately).
+
+    ``backoff_s`` arms exponential backoff between restarts (attempt n
+    sleeps ``backoff_s * backoff_factor**(n-1)``, capped at
+    ``max_backoff_s``) — in a real deployment the fault is usually
+    environmental and hammering the restart path makes it worse.
+    ``sleep`` is injectable for tests.
+
+    A ``DeviceLossError`` escalates past retry: when ``remesh`` is
+    given (``parallel/elastic.py::default_remesh``), it is called as
+    ``remesh(trainer, failure)`` and must return a NEW trainer on the
+    surviving mesh (carrying the memstore over, so the next ``fit``
+    reshards the snapshot onto the new world). Without ``remesh`` the
+    device loss restarts on the old mesh and will typically fail again
+    until ``max_restarts`` gives up.
+
+    Every transition emits a ``kind:"event"`` record on ``telemetry``
+    (a ``Telemetry`` or raw obs sink): ``recovery_restart`` per attempt
+    (with tier/backoff/failure), ``recovery_remesh`` on re-mesh,
+    ``recovery_complete`` / ``recovery_giveup`` at the end.
 
     Works with either engine — the CIFAR ``Trainer`` (``fit()`` ->
     ``(state, history)``) or ``LMTrainer`` (``fit(tokens, steps)`` ->
@@ -223,27 +354,87 @@ def run_with_recovery(
     ``restarts`` appended.
     """
     log = get_logger()
-    if not getattr(trainer.cfg, "checkpoint_dir", None):
+    if not (
+        getattr(trainer.cfg, "checkpoint_dir", None)
+        or getattr(trainer, "memstore", None) is not None
+    ):
         raise ValueError(
-            "run_with_recovery needs cfg.checkpoint_dir: restart-based "
-            "recovery resumes from the newest checkpoint"
+            "run_with_recovery needs cfg.checkpoint_dir or an in-memory "
+            "snapshot tier (trainer.memstore): restart-based recovery "
+            "resumes from the newest recoverable state"
         )
     kwargs = fit_kwargs or {}
     restarts = 0
     while True:
         try:
             result = trainer.fit(*fit_args, **kwargs)
+            if restarts:
+                emit_event(
+                    telemetry, "recovery_complete", restarts=restarts
+                )
             return (*result, restarts)
         except TrainingFailure as e:
             restarts += 1
             if restarts > max_restarts:
+                emit_event(
+                    telemetry,
+                    "recovery_giveup",
+                    restarts=restarts - 1,
+                    failure=repr(e),
+                )
                 log.critical(
                     "giving up after %d restarts (last failure: %s)", restarts - 1, e
                 )
                 raise
+            delay = 0.0
+            if backoff_s > 0:
+                delay = min(
+                    backoff_s * backoff_factor ** (restarts - 1),
+                    max_backoff_s,
+                )
+            tier = "restart"
+            if isinstance(e, DeviceLossError) and remesh is not None:
+                old_world = int(
+                    getattr(trainer, "mesh").devices.size
+                    if getattr(trainer, "mesh", None) is not None
+                    else 0
+                )
+                trainer = remesh(trainer, e)
+                new_world = int(
+                    getattr(trainer, "mesh").devices.size
+                    if getattr(trainer, "mesh", None) is not None
+                    else 0
+                )
+                tier = "remesh"
+                emit_event(
+                    telemetry,
+                    "recovery_remesh",
+                    old_world=old_world,
+                    new_world=new_world,
+                    lost=list(e.lost),
+                )
+                log.error(
+                    "device loss (%s): re-meshed %d -> %d devices",
+                    e,
+                    old_world,
+                    new_world,
+                )
+            emit_event(
+                telemetry,
+                "recovery_restart",
+                restart=restarts,
+                max_restarts=max_restarts,
+                failure=repr(e),
+                tier=tier,
+                backoff_s=delay,
+            )
             log.error(
-                "training failure (%s); restart %d/%d from newest checkpoint",
+                "training failure (%s); restart %d/%d from newest "
+                "recoverable state (backoff %.1fs)",
                 e,
                 restarts,
                 max_restarts,
+                delay,
             )
+            if delay > 0:
+                sleep(delay)

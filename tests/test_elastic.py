@@ -1,0 +1,170 @@
+"""Mesh-elastic restore (parallel/elastic.py + utils/memstore.py).
+
+The re-mesh discipline: a failed world's newest committed state restores
+onto a DIFFERENT world size deterministically — replicated params
+redistribute, per-replica BN stats slice/tile along their leading
+device axis, zero1/fsdp chunked optimizer shards re-chunk through the
+engines' elastic adapt hooks, and the data-sampler offset follows the
+restored step. These tests pin the matrix through the IN-MEMORY tier
+(``ReplicatedSnapshot`` handed across trainers — zero filesystem reads,
+asserted via the instrumented Checkpointer counters):
+
+- shrink and grow (dp4 <-> dp2) x zero1/fsdp on the LM engine, with the
+  resumed loss curve matching the uninterrupted run at rtol 1e-6
+  (chunking and reduction order are layout, not math);
+- CIFAR shrink/grow carrying per-replica BN batch_stats (mechanical:
+  per-replica normalization legitimately depends on the replica count,
+  so the pin is a correct resume, not trajectory parity);
+- ``surviving_mesh`` unit semantics (data-axis-only elasticity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import TINY_DP4_CFG
+
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.elastic import (
+    surviving_mesh,
+)
+from cs744_pytorch_distributed_tutorial_tpu.train import (
+    LMConfig,
+    LMTrainer,
+    Trainer,
+)
+from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
+    Checkpointer,
+)
+from cs744_pytorch_distributed_tutorial_tpu.utils.memstore import (
+    ReplicatedSnapshot,
+)
+
+TINY_LM = dict(
+    vocab_size=32, num_layers=1, num_heads=2, d_model=16, d_ff=32,
+    max_seq_len=64, seq_len=16, global_batch_size=8,
+    attention_impl="dense",
+)
+
+
+# ------------------------------------------------------ surviving_mesh
+
+
+def test_surviving_mesh_shrinks_data_axis_only():
+    devs = jax.devices()[:8]
+    mesh = make_mesh({"data": 4, "seq": 2}, devices=devs)
+    lost = {devs[1].id, devs[6].id}
+    new = surviving_mesh(mesh, lost)
+    assert dict(new.shape) == {"data": 3, "seq": 2}
+    assert {d.id for d in new.devices.flatten()}.isdisjoint(lost)
+
+
+def test_surviving_mesh_rejects_nondivisible_survivors():
+    mesh = make_mesh({"data": 4, "seq": 2}, devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="seq/tensor"):
+        surviving_mesh(mesh, [jax.devices()[0].id])  # 7 % 2 != 0
+
+
+def test_surviving_mesh_rejects_total_loss():
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="no devices survive"):
+        surviving_mesh(mesh, [d.id for d in jax.devices()[:2]])
+
+
+# -------------------------------------------------- ReplicatedSnapshot
+
+
+def test_replicated_snapshot_ring_retention():
+    snap = ReplicatedSnapshot(max_to_keep=2)
+    for step in (1, 2, 3):
+        snap.save({"w": jnp.full((4,), float(step))}, step=step)
+    assert snap.steps() == [2, 3]
+    assert snap.latest_step() == 3
+    template = {"w": jnp.zeros((4,))}
+    restored = snap.restore_latest(template)
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"]), np.full((4,), 3.0)
+    )
+    assert snap.saves == 3 and snap.restores == 1
+    snap.clear()
+    assert snap.latest_step() is None
+    assert snap.restore_latest(template) is None
+
+
+# --------------------------------------- LM matrix: shrink/grow x opt
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.parametrize("mode", ["zero1", "fsdp"])
+@pytest.mark.parametrize("dp_save,dp_resume", [(4, 2), (2, 4)])
+def test_lm_memstore_elastic_matrix(mode, dp_save, dp_resume):
+    """Save at dp_save in host RAM, hand the snapshot tier to a fresh
+    trainer at dp_resume: the chunked optimizer shards (and, for fsdp,
+    the chunked params) re-chunk through the elastic adapt hook, no
+    filesystem touched, and head+tail equals the uninterrupted dp_save
+    trajectory at rtol 1e-6."""
+    kw = {mode: True}
+    tokens = synthetic_tokens(8, 16, 32, seed=0)
+    mesh_a = make_mesh({"data": dp_save, "seq": 1},
+                       devices=jax.devices()[:dp_save])
+    mesh_b = make_mesh({"data": dp_resume, "seq": 1},
+                       devices=jax.devices()[:dp_resume])
+    tr = LMTrainer(
+        LMConfig(**TINY_LM, data_parallel=dp_save, snapshot_every=2, **kw),
+        mesh=mesh_a,
+    )
+    _, _, head = tr.fit(tokens, steps=4)
+
+    disk_restores_before = Checkpointer.total_restores
+    tr2 = LMTrainer(
+        LMConfig(**TINY_LM, data_parallel=dp_resume, snapshot_every=2, **kw),
+        mesh=mesh_b,
+        memstore=tr.memstore,
+    )
+    _, _, tail = tr2.fit(tokens, steps=6)
+    assert len(tail) == 2, tail
+    assert Checkpointer.total_restores == disk_restores_before
+    assert tr.memstore.restores >= 1
+
+    oracle = LMTrainer(
+        LMConfig(**TINY_LM, data_parallel=dp_save, **kw), mesh=mesh_a
+    )
+    _, _, full = oracle.fit(tokens, steps=6)
+    np.testing.assert_allclose(head + tail, full, rtol=1e-6)
+
+
+# ------------------------------------------- CIFAR BN-stats elasticity
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.parametrize("dp_save,dp_resume", [(4, 2), (2, 4)])
+def test_cifar_memstore_elastic_bn_stats(dp_save, dp_resume, mesh4):
+    """Per-replica BN batch_stats carry a leading [num_devices] axis;
+    the elastic restore slices (shrink) or cyclically tiles (grow) it to
+    the new world and training resumes at the recorded step."""
+    base = dict(TINY_DP4_CFG, sync="allreduce", log_every=1)
+    mesh_for = {
+        4: mesh4,
+        2: make_mesh({"data": 2}, devices=jax.devices()[:2]),
+    }
+    cfg_a = TrainConfig(**{**base, "num_devices": dp_save},
+                        snapshot_every=1)
+    tr = Trainer(cfg_a, mesh=mesh_for[dp_save])
+    state, _ = tr.fit()
+    assert int(np.asarray(state.step)) == 4  # one 4-step epoch
+
+    disk_restores_before = Checkpointer.total_restores
+    cfg_b = TrainConfig(**{**base, "num_devices": dp_resume},
+                        snapshot_every=1, epochs=2)
+    tr2 = Trainer(cfg_b, mesh=mesh_for[dp_resume], memstore=tr.memstore)
+    state2, history2 = tr2.fit()
+    assert Checkpointer.total_restores == disk_restores_before
+    assert tr.memstore.restores >= 1
+    assert int(np.asarray(state2.step)) == 8  # epoch 0 skipped, 1 trained
+    for leaf in jax.tree.leaves(state2.batch_stats):
+        assert leaf.shape[0] == dp_resume
+    assert np.isfinite(history2["eval"][-1]["avg_loss"])

@@ -45,6 +45,59 @@ def test_watchdog_quiet_on_fast_steps():
     assert wd.fired == 0
 
 
+def test_watchdog_escalation_ladder():
+    """escalation=("warn","dump","abort"): a persistently wedged section
+    climbs the ladder on its own — fire #1 warns (no callback), #2 dumps,
+    #3 aborts (callback fires) — with no help from the blocked training
+    thread."""
+    hangs = []
+    wd = StepWatchdog(
+        timeout_s=0.1,
+        on_hang=hangs.append,
+        dump_stacks=False,
+        escalation=("warn", "dump", "abort"),
+    )
+    wd.arm()
+    deadline = time.monotonic() + 5.0
+    while wd.fired < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    wd.disarm()
+    wd.close()
+    assert wd.fired == 3
+    assert wd.last_stage == "abort"
+    assert len(hangs) == 1  # only the "abort" rung runs the callback
+
+
+def test_watchdog_escalation_rejects_unknown_stage():
+    with pytest.raises(ValueError, match="escalation stages"):
+        StepWatchdog(timeout_s=1.0, escalation=("warn", "explode"))
+
+
+def test_watchdog_rearm_during_fire_cannot_double_fire():
+    """A callback that re-arms DURING an in-flight _fire (the lock is
+    re-entrant) starts a new section; the expired section still fires
+    exactly once, and a prompt disarm cancels the new section."""
+    wd = None
+    fires = []
+
+    def rearm_on_hang(elapsed):
+        fires.append(elapsed)
+        wd.arm(10.0)  # new section with a far deadline
+
+    wd = StepWatchdog(
+        timeout_s=0.1, on_hang=rearm_on_hang, dump_stacks=False
+    )
+    wd.arm()
+    deadline = time.monotonic() + 5.0
+    while wd.fired < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    time.sleep(0.3)  # old section's deadline long gone — must not refire
+    wd.disarm()  # cancels the callback's 10s section
+    wd.close()
+    assert wd.fired == 1
+    assert len(fires) == 1
+
+
 def _nan_injecting(trainer, fail_at_call: int, transient: bool):
     """Wrap trainer.train_step to return a NaN loss. ``transient``: NaN
     exactly once, on the Nth call (a flaky-chip analog). Persistent: NaN
@@ -145,6 +198,91 @@ def test_hang_action_validated(mesh4):
             TrainConfig(**TINY_DP4_CFG, sync="allreduce", hang_action="explode"),
             mesh=mesh4,
         )
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_run_with_recovery_backoff_and_events(mesh4, tmp_path):
+    """Exponential backoff between restarts (injectable sleep) and the
+    per-transition kind:"event" telemetry: one recovery_restart per
+    attempt carrying tier/backoff, recovery_giveup when exhausted."""
+    from cs744_pytorch_distributed_tutorial_tpu.obs.sinks import RingSink
+
+    cfg = TrainConfig(
+        **TINY_DP4_CFG,
+        sync="allreduce",
+        log_every=1,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_every=1,
+    )
+    tr = Trainer(cfg, mesh=mesh4)
+    _nan_injecting(tr, fail_at_call=2, transient=False)
+    sleeps = []
+    ring = RingSink()
+    with pytest.raises(NonFiniteLossError):
+        run_with_recovery(
+            tr,
+            max_restarts=2,
+            backoff_s=0.5,
+            sleep=sleeps.append,
+            telemetry=ring,
+        )
+    assert sleeps == [0.5, 1.0]  # backoff_s * 2^(n-1)
+    events = [r for r in ring.records() if r.get("kind") == "event"]
+    restarts = [e for e in events if e["event"] == "recovery_restart"]
+    assert [e["restart"] for e in restarts] == [1, 2]
+    assert [e["backoff_s"] for e in restarts] == [0.5, 1.0]
+    assert all(e["tier"] == "restart" for e in restarts)
+    giveups = [e for e in events if e["event"] == "recovery_giveup"]
+    assert len(giveups) == 1 and giveups[0]["restarts"] == 2
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_lm_recovery_from_memory_snapshot_zero_disk_reads():
+    """The in-memory snapshot tier alone (no checkpoint_dir) recovers an
+    LMTrainer run — and the recovery performs ZERO filesystem restores,
+    asserted through the instrumented Checkpointer counters."""
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_tokens
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.train import (
+        LMConfig,
+        LMTrainer,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.utils.checkpoint import (
+        Checkpointer,
+    )
+
+    mesh = make_mesh({"data": 2, "seq": 2})
+    tr = LMTrainer(
+        LMConfig(
+            vocab_size=32, num_layers=1, num_heads=2, d_model=16, d_ff=32,
+            max_seq_len=64, seq_len=16, global_batch_size=4,
+            attention_impl="ring", data_parallel=2, seq_parallel=2,
+            snapshot_every=1,
+        ),
+        mesh=mesh,
+    )
+    assert tr.memstore is not None  # built lazily from snapshot_every
+    real = tr.train_step
+    calls = {"n": 0}
+
+    def flaky(params, opt_state, x, y, step=0):
+        p, o, m = real(params, opt_state, x, y, step)
+        calls["n"] += 1
+        if calls["n"] == 3:  # transient: fails once, clean on replay
+            m = dict(m, loss=jnp.float32(float("inf")))
+        return p, o, m
+
+    tr.train_step = flaky
+    tokens = synthetic_tokens(8, 16, 32, seed=0)
+    disk_restores_before = Checkpointer.total_restores
+    params, opt, losses, restarts = run_with_recovery(
+        tr, fit_args=(tokens, 4), max_restarts=2
+    )
+    assert restarts == 1
+    assert np.isfinite(losses).all()
+    assert Checkpointer.total_restores == disk_restores_before
+    assert tr.memstore.restores >= 1
 
 
 def test_halt_on_nonfinite_can_be_disabled(mesh4):

@@ -1,0 +1,282 @@
+"""Serve kill/resume (ServingEngine.snapshot/resume): chaos for serving.
+
+A preempted instance must not corrupt streams: ``snapshot()`` captures
+every unfinished request (in-flight ones with the recompute-preemption
+transform pre-applied — produced tokens folded into the prompt), and
+``resume()`` on a FRESH engine replays them token-for-token identically.
+KV is deliberately not captured: recompute rebuilds it, and the
+per-request PRNG streams (keyed by request id and absolute output-token
+index) make the rebuild output-invariant — greedy bitwise, sampled via
+PRNG replay. Plus the observability spine: per-request ``kind:"serve"``
+lifecycle events (preempt / recovered), the ``recovered_requests``
+counter in ``stats()``/loadgen summaries, and the
+``benchmarks/metrics_summary.py`` chaos rows.
+
+The chaos-smoke CI job runs this file on CPU; docs/reliability.md is the
+operator story.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cs744_pytorch_distributed_tutorial_tpu.models import TransformerLM
+from cs744_pytorch_distributed_tutorial_tpu.serve import (
+    Request,
+    ServeConfig,
+    ServingEngine,
+    make_poisson_workload,
+    run_poisson,
+)
+
+VOCAB = 61
+CASES = [(3, 9), (7, 4), (12, 11), (5, 17), (9, 6)]
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(dict(record))
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    model = TransformerLM(
+        vocab_size=VOCAB,
+        num_layers=2,
+        num_heads=2,
+        d_model=32,
+        d_ff=64,
+        max_seq_len=64,
+        attention_impl="dense",
+        use_rope=True,
+    )
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return model, params
+
+
+def _submit_cases(eng, data_seed=7):
+    rng = np.random.default_rng(data_seed)
+    return [
+        eng.submit(Request(
+            prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            max_new_tokens=budget,
+        ))
+        for plen, budget in CASES
+    ]
+
+
+def _streams(reqs):
+    """Full produced stream per request id — the preemption/recovery
+    transform folds early generations into the prompt, so compare
+    prompt-tail + generated."""
+    return {
+        r.req_id: list(r.prompt[r.orig_prompt_len:]) + list(r.generated)
+        for r in reqs
+    }
+
+
+def _cfg(**kw):
+    base = dict(num_slots=2, page_size=4, num_pages=33, max_pages_per_slot=8)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.parametrize(
+    "sample",
+    [dict(), dict(temperature=0.9, top_k=20)],
+    ids=["greedy", "sampled"],
+)
+def test_kill_resume_streams_token_identical(tiny_lm, sample):
+    """Kill mid-decode, resume on a fresh engine: every request's final
+    stream equals the uninterrupted run's, greedy AND sampled — the
+    resumed prefill re-derives KV and the (req_id, token index) PRNG
+    keys continue the stream exactly where the kill landed."""
+    model, params = tiny_lm
+    cfg = _cfg(seed=3, **sample)
+
+    ref = ServingEngine(model, params, cfg)
+    ref_reqs = _submit_cases(ref)
+    ref.run()
+    expect = _streams(ref_reqs)
+
+    victim = ServingEngine(model, params, cfg)
+    victim_reqs = _submit_cases(victim)
+    for _ in range(5):  # mid-decode: slots live, tokens produced
+        victim.step()
+    assert any(r.generated for r in victim_reqs)
+    assert victim.busy  # the kill lands with work in flight
+    snap = victim.snapshot()
+    assert any(rec["in_flight"] for rec in snap.requests)
+    assert any(rec["replayed_tokens"] > 0 for rec in snap.requests)
+    del victim  # the process is gone; only the snapshot survives
+
+    fresh = ServingEngine(model, params, cfg)
+    resumed = fresh.resume(snap)
+    fresh.run()
+    done = {r.req_id: r for r in resumed}
+    # requests that completed on the victim engine before the kill are
+    # not in the snapshot; every unfinished one must finish identically
+    for rid, req in done.items():
+        assert req.done_time is not None
+        assert _streams([req])[rid] == expect[rid], rid
+    finished_before = {r.req_id for r in victim_reqs} - set(done)
+    assert set(done) | finished_before == set(expect)
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_resume_counts_and_emits_recovered_events(tiny_lm):
+    model, params = tiny_lm
+    cfg = _cfg(seed=3)
+    victim = ServingEngine(model, params, cfg)
+    _submit_cases(victim)
+    for _ in range(4):
+        victim.step()
+    snap = victim.snapshot()
+    in_flight = sum(1 for rec in snap.requests if rec["in_flight"])
+    assert in_flight > 0
+
+    sink = _ListSink()
+    fresh = ServingEngine(model, params, cfg, sink=sink)
+    fresh.resume(snap)
+    events = [r for r in sink.records if r.get("event") == "recovered"]
+    assert len(events) == in_flight
+    assert all(e["kind"] == "serve" for e in events)
+    assert fresh.stats()["recovered_requests"] == in_flight
+    fresh.run()
+    # the counter is cumulative for the engine's lifetime
+    assert fresh.stats()["recovered_requests"] == in_flight
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_resume_guards(tiny_lm):
+    model, params = tiny_lm
+    victim = ServingEngine(model, params, _cfg(seed=3))
+    _submit_cases(victim)
+    for _ in range(3):
+        victim.step()
+    snap = victim.snapshot()
+
+    busy = ServingEngine(model, params, _cfg(seed=3))
+    busy.submit(Request(prompt=np.ones((4,), np.int32), max_new_tokens=4))
+    with pytest.raises(RuntimeError, match="idle engine"):
+        busy.resume(snap)
+
+    reseeded = ServingEngine(model, params, _cfg(seed=4))
+    with pytest.raises(ValueError, match="seed"):
+        reseeded.resume(snap)
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_snapshot_does_not_disturb_live_engine(tiny_lm):
+    """snapshot() is a pure read: the live engine keeps serving and its
+    outputs still match the uninterrupted reference."""
+    model, params = tiny_lm
+    cfg = _cfg(seed=3)
+    ref = ServingEngine(model, params, cfg)
+    ref_reqs = _submit_cases(ref)
+    ref.run()
+
+    eng = ServingEngine(model, params, cfg)
+    reqs = _submit_cases(eng)
+    for _ in range(4):
+        eng.step()
+    eng.snapshot()
+    eng.run()
+    assert _streams(reqs) == _streams(ref_reqs)
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_preempt_events_match_counter(tiny_lm):
+    """Each recompute preemption emits one kind:"serve" preempt event
+    with the replayed-token count — the per-request chaos visibility
+    metrics_summary tallies."""
+    model, params = tiny_lm
+    sink = _ListSink()
+    cfg = ServeConfig(num_slots=3, page_size=4, num_pages=9,
+                      max_pages_per_slot=7)
+    eng = ServingEngine(model, params, cfg, sink=sink)
+    rng = np.random.default_rng(13)
+    for plen, budget in [(6, 18), (10, 14), (8, 16), (5, 20), (12, 12)]:
+        eng.submit(Request(
+            prompt=rng.integers(1, VOCAB, size=plen).astype(np.int32),
+            max_new_tokens=budget,
+        ))
+    eng.run()
+    assert eng.stats()["preemptions"] > 0, "pool was not tight enough"
+    events = [r for r in sink.records if r.get("event") == "preempt"]
+    assert len(events) == eng.stats()["preemptions"]
+    assert all(e["kind"] == "serve" for e in events)
+    assert all(e["replayed_tokens"] >= 0 for e in events)
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_loadgen_reports_recovered_twin(tiny_lm):
+    """A resumed engine driven by the load generator carries the
+    recovery count into the serve_summary record and the bench-shaped
+    serve_recovered twin regress.py gates."""
+    model, params = tiny_lm
+    cfg = _cfg(seed=3)
+    victim = ServingEngine(model, params, cfg)
+    _submit_cases(victim)
+    for _ in range(4):
+        victim.step()
+    snap = victim.snapshot()
+
+    sink = _ListSink()
+    fresh = ServingEngine(model, params, cfg, sink=sink)
+    fresh.resume(snap)
+    recovered = fresh.stats()["recovered_requests"]
+    assert recovered > 0
+    wl = make_poisson_workload(
+        num_requests=3, rate_rps=100.0, prompt_len=(3, 6),
+        output_len=(2, 4), vocab_size=VOCAB, seed=5,
+    )
+    record = run_poisson(fresh, wl, sink=sink, warmup=False)
+    assert record["recovered_requests"] == recovered
+    twins = [
+        r for r in sink.records
+        if r.get("kind") == "bench" and r.get("metric") == "serve_recovered"
+    ]
+    assert len(twins) == 1 and twins[0]["value"] == recovered
+
+
+def test_metrics_summary_counts_chaos_rows():
+    """summarize() tallies the per-request lifecycle events and surfaces
+    the recovered count from serve summaries (pure function — fed a
+    synthetic record stream)."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "metrics_summary",
+        Path(__file__).resolve().parents[1]
+        / "benchmarks" / "metrics_summary.py",
+    )
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    records = [
+        {"kind": "serve", "event": "preempt", "id": 1, "replayed_tokens": 3},
+        {"kind": "serve", "event": "preempt", "id": 2, "replayed_tokens": 0},
+        {"kind": "serve", "event": "recovered", "id": 1,
+         "replayed_tokens": 4},
+        {"kind": "serve_summary", "engine": "continuous", "requests": 5,
+         "ttft_p50_ms": 1.0, "ttft_p99_ms": 2.0, "tokens_per_sec": 10.0,
+         "preemptions": 2, "recovered_requests": 1},
+    ]
+    summary = ms.summarize(records)
+    assert summary["serve_preempt_replays"] == 2
+    assert summary["serve_recovered"] == 1
+    assert summary["serve"]["continuous"]["recovered_requests"] == 1
