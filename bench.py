@@ -379,7 +379,9 @@ def _parse_args() -> argparse.Namespace:
         default=None,
         help="delegate to the continuous-batching serving benchmark "
         "(serve_cli, docs/serving.md): every argument AFTER --serve "
-        "passes through, e.g. bench.py --serve --requests 32 --gate. "
+        "passes through, e.g. bench.py --serve --requests 32 --gate "
+        "or bench.py --serve --trace-dir /tmp/trace --window-every "
+        "0.25 (graftserve spans + SLO windows, docs/observability.md). "
         "A --metrics-dir given before --serve is forwarded.",
     )
     return p.parse_args()

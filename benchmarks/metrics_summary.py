@@ -50,11 +50,15 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
     wire = [r["grad_sync_bytes"] for r in steps
             if isinstance(r.get("grad_sync_bytes"), (int, float))]
     events = [r for r in records if r.get("kind") == "event"]
-    # graftscope per-phase records (bench.py --phase-breakdown): one row
-    # per phase, keyed by name, latest record wins on repeat runs.
+    # graftscope per-phase records (bench.py --phase-breakdown) plus the
+    # serve-side kind:"serve_phase" twins (serve_cli --trace-dir): one
+    # row per phase, keyed by name, latest record wins on repeat runs.
     phases: dict[str, dict[str, Any]] = {}
     for r in records:
-        if r.get("kind") == "phase" and isinstance(r.get("phase"), str):
+        kind = r.get("kind")
+        if kind in ("phase", "serve_phase") and isinstance(
+            r.get("phase"), str
+        ):
             row = {
                 k: r.get(k)
                 for k in ("clock", "flops", "bytes_accessed",
@@ -65,7 +69,8 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
                 if r.get("clock") == "device"
                 else r.get("wall_ms")
             )
-            phases[r["phase"]] = row
+            name = r["phase"]
+            phases[f"serve {name}" if kind == "serve_phase" else name] = row
     sync_exposed = [
         float(r["sync_exposed_ms"]) for r in records
         if r.get("kind") == "phase_summary"
@@ -99,6 +104,40 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
                           "slot_occupancy", "preemptions",
                           "recovered_requests")
             }
+    # graftserve windowed SLO telemetry (obs/serve_trace.py): one
+    # aggregate row over every kind:"serve_window" record — TTFT/ITL
+    # p99 trajectory (last + worst window), peak pool occupancy, queue
+    # depth, preemption rate.
+    windows = [r for r in records if r.get("kind") == "serve_window"]
+    serve_windows: dict[str, Any] | None = None
+    if windows:
+        def _col(key: str) -> list[float]:
+            return [w[key] for w in windows
+                    if isinstance(w.get(key), (int, float))]
+
+        ttft = _col("ttft_p99_ms")
+        itl = _col("itl_p99_ms")
+        serve_windows = {
+            "count": len(windows),
+            "span_s": windows[-1].get("t_s"),
+            "ttft_p99_ms_last": ttft[-1] if ttft else None,
+            "ttft_p99_ms_max": max(ttft) if ttft else None,
+            "itl_p99_ms_last": itl[-1] if itl else None,
+            "itl_p99_ms_max": max(itl) if itl else None,
+            "live_pages_peak": max(_col("live_pages"), default=None),
+            "queue_depth_max": max(_col("queue_depth_max"), default=None),
+            "preempt_rate_per_s_max": max(
+                _col("preempt_rate_per_s"), default=None
+            ),
+        }
+    # decode_host_exposed_ms (kind:"serve_phase_summary"): host
+    # scheduling overhead per live decode step — the serving analog of
+    # sync_exposed_ms.
+    host_exposed = [
+        float(r["decode_host_exposed_ms"]) for r in records
+        if r.get("kind") == "serve_phase_summary"
+        and isinstance(r.get("decode_host_exposed_ms"), (int, float))
+    ]
     # Chaos visibility (docs/reliability.md): per-request kind:"serve"
     # lifecycle events — preemption replays and kill/resume recoveries
     # (serve/engine.py emits one record per transition).
@@ -125,6 +164,10 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
         "sync_compare": sync_compare,
         "serve": serve,
+        "serve_windows": serve_windows,
+        "serve_decode_host_exposed_ms": (
+            host_exposed[-1] if host_exposed else None
+        ),
         "serve_preempt_replays": preempt_replays,
         "serve_recovered": recovered,
     }
@@ -181,6 +224,23 @@ def main(argv: list[str] | None = None) -> int:
             f"{_fmt(row.get('page_high_water'))}, occupancy "
             f"{_fmt(round(occ, 3) if isinstance(occ, float) else occ)}"
             + (f", recovered {_fmt(recovered)}" if recovered else ""),
+        ))
+    sw = summary["serve_windows"]
+    if sw:
+        rows.append((
+            "serve windows",
+            f"{_fmt(sw['count'])} over {_fmt(sw['span_s'])} s, TTFT p99 "
+            f"last/max {_fmt(sw['ttft_p99_ms_last'])}/"
+            f"{_fmt(sw['ttft_p99_ms_max'])} ms, ITL p99 last/max "
+            f"{_fmt(sw['itl_p99_ms_last'])}/{_fmt(sw['itl_p99_ms_max'])} ms, "
+            f"pages peak {_fmt(sw['live_pages_peak'])}, queue max "
+            f"{_fmt(sw['queue_depth_max'])}, preempt/s max "
+            f"{_fmt(sw['preempt_rate_per_s_max'])}",
+        ))
+    if summary["serve_decode_host_exposed_ms"] is not None:
+        rows.append((
+            "serve decode host exposed (ms)",
+            summary["serve_decode_host_exposed_ms"],
         ))
     if summary["serve_preempt_replays"] or summary["serve_recovered"]:
         rows.append((

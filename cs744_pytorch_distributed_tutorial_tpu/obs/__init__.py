@@ -25,6 +25,12 @@ from .phases import (
     render_phase_table,
 )
 from .run_manifest import build_manifest, read_manifest, write_manifest
+from .serve_trace import (
+    ServeTracer,
+    check_spans,
+    profile_serve_programs,
+    reconcile,
+)
 from .sinks import (
     CsvSink,
     JsonlSink,
@@ -59,6 +65,10 @@ __all__ = [
     "build_manifest",
     "read_manifest",
     "write_manifest",
+    "ServeTracer",
+    "check_spans",
+    "profile_serve_programs",
+    "reconcile",
     "CsvSink",
     "JsonlSink",
     "MetricSink",

@@ -1,12 +1,20 @@
 """Render telemetry artifacts from the command line.
 
     python -m cs744_pytorch_distributed_tutorial_tpu.obs report <metrics_dir>
+    python -m cs744_pytorch_distributed_tutorial_tpu.obs serve-report \\
+        <trace_dir> [--check]
 
 ``report`` reads a metrics dir (or a metrics.jsonl / phase_report.json
 directly), filters the graftscope ``kind="phase"``/``"phase_summary"``
 records, and prints the per-phase attribution table — same renderer
 ``bench.py --phase-breakdown`` prints live, usable after the fact on
 any machine the JSONL landed on.
+
+``serve-report`` summarizes a graftserve trace dir (``serve_cli.py
+--trace-dir``: span/window/request JSONL + the Perfetto trace);
+``--check`` additionally runs the span-consistency audit (no orphan,
+unclosed, or overlapping spans; span sums reconcile with recorded
+TTFT) and exits 1 on any problem — the CI serve-smoke gate.
 """
 
 from __future__ import annotations
@@ -66,7 +74,44 @@ def main(argv: list[str] | None = None) -> int:
     rep.add_argument(
         "path", help="metrics dir, metrics.jsonl, or phase_report.json"
     )
+    srv = sub.add_parser(
+        "serve-report", help="summarize a graftserve trace dir"
+    )
+    srv.add_argument(
+        "path",
+        help="trace dir written by serve_cli --trace-dir, or a "
+             "serve_spans.jsonl",
+    )
+    srv.add_argument(
+        "--check",
+        action="store_true",
+        help="exit 1 on orphan/unclosed/overlapping spans or TTFT "
+             "reconciliation drift",
+    )
     args = p.parse_args(argv)
+
+    if args.cmd == "serve-report":
+        from .serve_trace import (
+            check_spans,
+            load_trace_dir,
+            reconcile,
+            render_serve_report,
+        )
+
+        data = load_trace_dir(args.path)
+        print(render_serve_report(data))
+        if args.check:
+            problems = check_spans(data["spans"])
+            problems += reconcile(data["spans"], data["requests"])
+            if problems:
+                for prob in problems:
+                    print(f"serve-trace check: {prob}", file=sys.stderr)
+                return 1
+            print(
+                f"serve-trace check: OK ({len(data['spans'])} spans, "
+                f"{len(data['requests'])} requests)"
+            )
+        return 0
 
     records = phase_records_from_stream(_load_stream(args.path))
     if not records:

@@ -173,6 +173,14 @@ class FlightRecorder:
     ``metrics_summary`` can count them. Dump triggers: watchdog fire
     (wired in ``utils/failure.py``), uncaught exception + SIGTERM (via
     ``install()``), or an explicit call.
+
+    Engines can attach domain state: ``header_fn`` returns extra flat
+    fields merged into the ``flight_dump`` header (e.g. the serving
+    engine's pool high-water and queue depth), and each ``tails`` entry
+    (name -> zero-arg fn returning flat records) dumps its last
+    ``ring_tail`` records as ``flight_<name>`` events — the serving
+    engine hands its serve-event ring over this way
+    (``ServingEngine.make_flight_recorder``).
     """
 
     def __init__(
@@ -182,12 +190,16 @@ class FlightRecorder:
         hbm: HbmHighWater | None = None,
         ring_tail: int = 32,
         emit: Callable[..., None] | None = None,
+        tails: dict[str, Callable[[], list]] | None = None,
+        header_fn: Callable[[], dict] | None = None,
     ):
         if telemetry is None and emit is None:
             raise ValueError("FlightRecorder needs a telemetry or an emit fn")
         self._emit = emit if emit is not None else telemetry.emit_event
         self.straggler = straggler
         self.hbm = hbm
+        self._tails = dict(tails or {})
+        self._header_fn = header_fn
         self.ring_tail = int(ring_tail)
         self.dumps = 0
         self._lock = threading.Lock()
@@ -208,12 +220,17 @@ class FlightRecorder:
                 if self.hbm is not None:
                     self.hbm.snapshot()
                     header.update(self.hbm.highwater())
+                if self._header_fn is not None:
+                    header.update(self._header_fn())
                 self._emit("flight_dump", **header)
                 if self.straggler is not None:
                     for rec in self.straggler.tail(self.ring_tail):
                         self._emit("flight_step", **rec)
                     for out in list(self.straggler.outliers):
                         self._emit("flight_straggler", **out)
+                for name, tail_fn in self._tails.items():
+                    for rec in list(tail_fn())[-self.ring_tail:]:
+                        self._emit(f"flight_{name}", **rec)
             except Exception:
                 pass
 

@@ -137,6 +137,18 @@ class Request:
     # these keep the ORIGINAL accounting across the re-queue.
     orig_prompt_len: int = -1
     orig_max_new_tokens: int = -1
+    # kill/resume bookkeeping (``ServingEngine.resume``): ``recovered``
+    # marks a request replayed from a ServeSnapshot; each entry of
+    # ``resume_boundaries`` is the ``token_times`` index of the first
+    # post-resume token, so the gap it opens against the previous token
+    # — the kill gap, stamped on a DIFFERENT process's clock — can be
+    # excluded from ITL percentiles (serve/loadgen.py) and from the
+    # tracer's ITL reservoir (obs/serve_trace.py).
+    recovered: bool = False
+    resume_boundaries: list[int] = field(default_factory=list)
+    # set by ``resume`` on in-flight requests: the next admission is a
+    # resume-replay (span vocabulary), not an ordinary recompute.
+    replay_pending: bool = False
 
     @property
     def output_tokens(self) -> int:
@@ -199,6 +211,7 @@ class ServingEngine:
         sink: Any = None,
         clock: Callable[[], float] = time.monotonic,
         on_token: Callable[[Request, int], None] | None = None,
+        tracer: Any = None,
     ) -> None:
         check_decode_model(model, "serving", allow_tensor=mesh is not None)
         if cfg.num_slots < 1:
@@ -227,9 +240,17 @@ class ServingEngine:
         self.params = params
         self.mesh = mesh
         self.param_specs = param_specs
+        if tracer is not None and getattr(
+            tracer, "num_slots", cfg.num_slots
+        ) != cfg.num_slots:
+            raise ValueError(
+                f"tracer was built for {tracer.num_slots} slots, engine "
+                f"has {cfg.num_slots}"
+            )
         self.sink = sink
         self.clock = clock
         self.on_token = on_token
+        self.tracer = tracer
         self.pool = PagePool(cfg.num_pages, cfg.page_size)
         self.model = model.clone(
             page_size=cfg.page_size,
@@ -249,6 +270,15 @@ class ServingEngine:
         self._active_slot_steps = 0
         self._preemptions = 0
         self._recovered = 0  # requests resumed from a ServeSnapshot
+        # graftserve bookkeeping (obs/serve_trace.py + obs/flight.py):
+        # the tail of every emitted serve record (crash-dump payload),
+        # host wall per decode step (decode_host_exposed_ms), trash-page
+        # rows written by the fixed-shape programs, and an optional
+        # decode-step straggler window (make_flight_recorder).
+        self._event_ring: deque[dict[str, Any]] = deque(maxlen=256)
+        self._decode_walls: deque[float] = deque(maxlen=4096)
+        self._trash_rows = 0
+        self._straggler: Any = None
         self._completed: list[Request] = []
         self._base_key = jax.random.key(cfg.seed)
         # One PRNG stream PER REQUEST, indexed by absolute output-token
@@ -529,11 +559,113 @@ class ServingEngine:
         if req.arrival_time is None:
             req.arrival_time = req.submit_time
         self._queue.append(req)
+        if self.tracer is not None:
+            self.tracer.on_submit(req, req.submit_time)
         return req
 
     @property
     def busy(self) -> bool:
         return bool(self._queue) or any(s is not None for s in self._slots)
+
+    # ------------------------------------------------------- telemetry
+
+    def _emit(self, record: dict[str, Any]) -> None:
+        """Route one record to the sink AND the in-memory event ring —
+        the ring is what the flight recorder dumps on a crash, so it
+        keeps the tail even when the sink is detached (warmup)."""
+        self._event_ring.append(record)
+        if self.sink is not None:
+            self.sink.emit(record)
+
+    def _pool_stats(self) -> dict[str, int]:
+        """Pool counters at decode-step cadence for the tracer's
+        utilization time series and SLO windows."""
+        pool = self.pool
+        return {
+            "live": pool.allocated_pages,
+            "free": pool.free_pages,
+            "high_water": pool.high_water,
+            "churn": pool.total_allocs + pool.total_frees,
+            "trash": self._trash_rows,
+        }
+
+    def finalize_trace(self) -> None:
+        """Flush the tracer's final partial SLO window through the sink
+        (``run_poisson`` calls this once the engine drains)."""
+        if self.tracer is None:
+            return
+        rec = self.tracer.flush_window(
+            self.clock(), queue_depth=len(self._queue)
+        )
+        if rec is not None:
+            self._emit(rec)
+
+    def make_flight_recorder(
+        self,
+        telemetry: Any = None,
+        *,
+        emit: Callable[..., None] | None = None,
+        ring_tail: int = 32,
+        hbm: bool = True,
+    ) -> Any:
+        """A FlightRecorder over the serving loop — the serve analog of
+        what ``LMTrainer.fit`` wires for training: a crash/watchdog/
+        SIGTERM dump carries the pool + queue high-water header, the
+        decode-step straggler window, and the tail of the serve event
+        ring (preempt/request/recovered/serve_window records). With no
+        telemetry/emit given, dump events flow through the engine's own
+        sink."""
+        from cs744_pytorch_distributed_tutorial_tpu.obs.flight import (
+            FlightRecorder,
+            HbmHighWater,
+            StragglerMonitor,
+        )
+
+        if self._straggler is None:
+            self._straggler = StragglerMonitor()
+        if telemetry is None and emit is None:
+            def emit(event, **fields):
+                self._emit({
+                    "kind": "event", "event": event, "time": time.time(),
+                    **fields,
+                })
+
+        def serve_tail():
+            # Re-key the ring records so they nest under flight_serve
+            # events without colliding with Telemetry's kind/event/time.
+            out = []
+            for rec in list(self._event_ring)[-ring_tail:]:
+                row = {}
+                for k, v in rec.items():
+                    if k == "kind":
+                        continue
+                    row["serve_event" if k == "event" else
+                        "t" if k == "time" else k] = v
+                out.append(row)
+            return out
+
+        def header():
+            pool = self.pool
+            return {
+                "queue_depth": len(self._queue),
+                "active_slots": sum(s is not None for s in self._slots),
+                "decode_steps": self._step_count,
+                "preemptions": self._preemptions,
+                "pages_live": pool.allocated_pages,
+                "page_high_water": pool.high_water,
+                "page_churn": pool.total_allocs + pool.total_frees,
+                "trash_rows_written": self._trash_rows,
+            }
+
+        return FlightRecorder(
+            telemetry=telemetry,
+            straggler=self._straggler,
+            hbm=HbmHighWater() if hbm else None,
+            ring_tail=ring_tail,
+            emit=emit,
+            tails={"serve": serve_tail},
+            header_fn=header,
+        )
 
     # ------------------------------------------------------ scheduling
 
@@ -556,14 +688,16 @@ class ServingEngine:
         req.preemptions += 1
         self._preemptions += 1
         replayed = len(req.generated)
-        if self.sink is not None:
-            self.sink.emit({
-                "kind": "serve",
-                "event": "preempt",
-                "time": time.time(),
-                "id": req.req_id,
-                "replayed_tokens": replayed,
-            })
+        now = self.clock()
+        if self.tracer is not None:
+            self.tracer.on_preempt(req, victim_idx, now, replayed)
+        self._emit({
+            "kind": "serve",
+            "event": "preempt",
+            "time": time.time(),
+            "id": req.req_id,
+            "replayed_tokens": replayed,
+        })
         # prompt + everything generated so far (minus nothing: the last
         # sampled token re-enters as prompt tail and its KV recomputes)
         req.prompt = np.concatenate(
@@ -574,6 +708,8 @@ class ServingEngine:
         self._free_slot(victim_idx)
         if req.max_new_tokens >= 1:
             self._queue.appendleft(req)
+            if self.tracer is not None:
+                self.tracer.on_requeue(req, now)
         else:  # budget spent exactly at preemption — it is just done
             self._finish(req)
         return True
@@ -592,7 +728,20 @@ class ServingEngine:
         return True
 
     def _admit(self, slot_idx: int, req: Request) -> None:
+        t_admit = self.clock()
+        # Span vocabulary for this admission (obs/serve_trace.py): a
+        # first admission is a plain prefill, a preempted request's
+        # re-admission is a recompute, and a resumed in-flight request's
+        # first re-admission is a resume-replay.
+        if req.replay_pending:
+            admit_kind = "resume-replay"
+        elif req.preemptions > 0:
+            admit_kind = "recompute"
+        else:
+            admit_kind = "prefill"
+        req.replay_pending = False
         plen = int(req.prompt.size)
+        replayed = max(0, plen - req.orig_prompt_len)
         need = max(1, self.pool.pages_for(plen))
         pages = self.pool.alloc(need)
         row = np.zeros((self.cfg.max_pages_per_slot,), np.int32)
@@ -619,8 +768,20 @@ class ServingEngine:
         )
         tok = int(first_tok)  # blocks — the request's first token
         now = self.clock()
-        if req.first_token_time is None:
+        first = req.first_token_time is None
+        if first:
             req.first_token_time = now
+        # Rows [plen, bucket) of the padded prompt scattered to trash.
+        self._trash_rows += bucket - plen
+        if self.tracer is not None:
+            self.tracer.on_admit(
+                req, slot=slot_idx, bucket=bucket, t0=t_admit, t1=now,
+                kind=admit_kind, replayed=replayed,
+            )
+            if first:
+                self.tracer.sample_ttft(
+                    (now - req.arrival_time) * 1e3, now
+                )
         req.generated.append(tok)
         self._surface(req, tok, now)
         self._admit_seq += 1
@@ -642,30 +803,32 @@ class ServingEngine:
     def _retire(self, i: int) -> None:
         req = self._slots[i].req
         self._free_slot(i)
-        self._finish(req)
+        self._finish(req, slot=i)
 
-    def _finish(self, req: Request) -> None:
+    def _finish(self, req: Request, slot: int | None = None) -> None:
         req.done_time = self.clock()
         self._completed.append(req)
-        if self.sink is not None:
-            ttft_ms = (req.first_token_time - req.arrival_time) * 1e3
-            queue_ms = (req.submit_time - req.arrival_time) * 1e3
-            decode_s = req.done_time - req.first_token_time
-            out = req.output_tokens
-            self.sink.emit({
-                "kind": "serve",
-                "event": "request",
-                "time": time.time(),
-                "id": req.req_id,
-                "prompt_tokens": req.orig_prompt_len,
-                "output_tokens": out,
-                "queue_ms": round(queue_ms, 3),
-                "ttft_ms": round(ttft_ms, 3),
-                "decode_ms_per_token": round(
-                    decode_s * 1e3 / max(1, out - 1), 4
-                ),
-                "preemptions": req.preemptions,
-            })
+        if self.tracer is not None:
+            self.tracer.on_retire(req, slot, req.done_time)
+        ttft_ms = (req.first_token_time - req.arrival_time) * 1e3
+        queue_ms = (req.submit_time - req.arrival_time) * 1e3
+        decode_s = req.done_time - req.first_token_time
+        out = req.output_tokens
+        self._emit({
+            "kind": "serve",
+            "event": "request",
+            "time": time.time(),
+            "id": req.req_id,
+            "prompt_tokens": req.orig_prompt_len,
+            "output_tokens": out,
+            "queue_ms": round(queue_ms, 3),
+            "ttft_ms": round(ttft_ms, 3),
+            "decode_ms_per_token": round(
+                decode_s * 1e3 / max(1, out - 1), 4
+            ),
+            "preemptions": req.preemptions,
+            "recovered": req.recovered,
+        })
 
     # ------------------------------------------------------------ loop
 
@@ -717,6 +880,7 @@ class ServingEngine:
 
         # decode one token for every active slot
         cfg = self.cfg
+        t_d0 = self.clock()
         tokens = np.full((cfg.num_slots,), cfg.pad_id, np.int32)
         lengths = np.zeros((cfg.num_slots,), np.int32)
         active = np.zeros((cfg.num_slots,), bool)
@@ -746,8 +910,28 @@ class ServingEngine:
         )
         toks = np.asarray(toks)  # graftlint: disable=GL001 -- the scheduler NEEDS this sync: retire/refill decisions read the sampled tokens; one fetch per engine step, outside any jit
         self._step_count += 1
-        self._active_slot_steps += int(active.sum())
+        n_active = int(active.sum())
+        self._active_slot_steps += n_active
+        # Inactive slots still write one KV row per step — to the trash
+        # page (fixed-shape contract).
+        self._trash_rows += cfg.num_slots - n_active
         now = self.clock()
+        self._decode_walls.append(now - t_d0)
+        if self._straggler is not None:
+            self._straggler.record(self._step_count, now - t_d0)
+        window = None
+        if self.tracer is not None:
+            # Snapshot slot residency BEFORE retiring — the hook extends
+            # each live slot's coalesced decode_run span to ``now``, the
+            # same stamp the tokens below surface with.
+            slot_reqs = {
+                i: s.req.req_id
+                for i, s in enumerate(self._slots)
+                if s is not None
+            }
+            window = self.tracer.on_decode_step(
+                t_d0, now, slot_reqs, self._pool_stats(), len(self._queue)
+            )
         for i, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -757,6 +941,8 @@ class ServingEngine:
             self._surface(slot.req, slot.last_tok, now)
             if self._slot_done(slot):
                 self._retire(i)
+        if window is not None:
+            self._emit(window)
         return self._completed[done_before:]
 
     def run(self) -> list[Request]:
@@ -772,6 +958,16 @@ class ServingEngine:
         stamp its wall-clock surface time and fire the ``on_token``
         callback. Called from prefill admission (the first token) and
         from every decode step."""
+        if self.tracer is not None and req.token_times:
+            # Feed the tracer's rolling ITL reservoir the same gap
+            # loadgen's post-hoc np.diff will compute — EXCEPT across a
+            # resume boundary, where the gap spans the kill (and two
+            # clock epochs); loadgen excludes those too, so windowed
+            # and post-hoc percentiles agree.
+            if len(req.token_times) not in req.resume_boundaries:
+                self.tracer.sample_itl(
+                    (now - req.token_times[-1]) * 1e3, now
+                )
         req.token_times.append(now)
         if self.on_token is not None:
             self.on_token(req, tok)
@@ -833,6 +1029,7 @@ class ServingEngine:
                 "arrival_time": req.arrival_time,
                 "first_token_time": req.first_token_time,
                 "token_times": list(req.token_times),
+                "resume_boundaries": list(req.resume_boundaries),
                 "replayed_tokens": replayed,
                 "in_flight": in_flight,
             }
@@ -887,17 +1084,24 @@ class ServingEngine:
             req.preemptions = int(rec["preemptions"])
             req.first_token_time = rec["first_token_time"]
             req.token_times = list(rec["token_times"])
+            req.resume_boundaries = list(rec.get("resume_boundaries", []))
+            if req.token_times:
+                # The next surfaced token lands at this index — the gap
+                # it opens spans the kill (and two clock epochs), so ITL
+                # percentiles must skip it (loadgen._summarize).
+                req.resume_boundaries.append(len(req.token_times))
+            req.recovered = True
+            req.replay_pending = bool(rec["in_flight"])
             self.submit(req)
             if rec["in_flight"]:
                 self._recovered += 1
-                if self.sink is not None:
-                    self.sink.emit({
-                        "kind": "serve",
-                        "event": "recovered",
-                        "time": time.time(),
-                        "id": req.req_id,
-                        "replayed_tokens": int(rec["replayed_tokens"]),
-                    })
+                self._emit({
+                    "kind": "serve",
+                    "event": "recovered",
+                    "time": time.time(),
+                    "id": req.req_id,
+                    "replayed_tokens": int(rec["replayed_tokens"]),
+                })
             out.append(req)
         self._next_id = max(self._next_id, int(snap.next_id))
         return out
@@ -915,6 +1119,8 @@ class ServingEngine:
             "pages_allocatable": self.cfg.num_pages - 1,
             "preemptions": self._preemptions,
             "recovered_requests": self._recovered,
+            "page_churn": self.pool.total_allocs + self.pool.total_frees,
+            "trash_rows_written": self._trash_rows,
         }
 
 

@@ -114,8 +114,22 @@ def _summarize(
     itls: list[float] = []
     for r in reqs:
         if len(r.token_times) > 1:
+            diffs = np.diff(np.asarray(r.token_times))
+            # A recovered request's token_times mix the dead process's
+            # clock epoch with the resumed engine's: the diff across
+            # each resume boundary "measures" the kill gap (or worse, a
+            # negative monotonic-clock delta), not an inter-token
+            # latency. Exclude exactly those gaps; every real gap —
+            # including preemption stalls — still counts.
+            skip = {
+                b - 1
+                for b in getattr(r, "resume_boundaries", ())
+                if 1 <= b <= len(diffs)
+            }
             itls.extend(
-                float(d) * 1e3 for d in np.diff(np.asarray(r.token_times))
+                float(d) * 1e3
+                for i, d in enumerate(diffs)
+                if i not in skip
             )
     total_tokens = sum(r.output_tokens for r in reqs)
     return {
@@ -143,6 +157,7 @@ def run_poisson(
     *,
     sink: Any = None,
     warmup: bool = True,
+    watchdog: Any = None,
 ) -> dict[str, Any]:
     """Replay ``workload`` open-loop against the engine on the wall
     clock and return (and emit) the ``serve_summary`` record.
@@ -150,11 +165,16 @@ def run_poisson(
     ``warmup=True`` first runs one throwaway request per prefill bucket
     plus a decode step, so compile time does not pollute the measured
     TTFTs (and so the post-warmup 0-retrace contract covers the whole
-    measured run)."""
+    measured run). A ``StepWatchdog`` passed as ``watchdog`` arms
+    around every measured engine step — wire its ``flight_recorder`` to
+    ``engine.make_flight_recorder()`` so a wedged step dumps the serve
+    event ring (docs/observability.md)."""
     clock = engine.clock
     if warmup:
         buckets = sorted({engine._bucket_for(len(p)) for p in workload.prompts})
-        saved_sink, engine.sink = engine.sink, None  # no warmup records
+        # no warmup records, no warmup spans
+        saved_sink, engine.sink = engine.sink, None
+        saved_tracer, engine.tracer = engine.tracer, None
         try:
             for b in buckets:
                 plen = min(b, engine.max_seq_len - 1)
@@ -166,12 +186,20 @@ def run_poisson(
             engine.run()
         finally:
             engine.sink = saved_sink
+            engine.tracer = saved_tracer
         # warmup requests must not count against the measurement
         engine._completed.clear()
         engine._preemptions = 0
         engine._step_count = 0
         engine._active_slot_steps = 0
+        engine._trash_rows = 0
+        engine._decode_walls.clear()
+        engine._event_ring.clear()
         engine.pool.high_water = engine.pool.allocated_pages
+        engine.pool.total_allocs = 0
+        engine.pool.total_frees = 0
+        if engine.tracer is not None:
+            engine.tracer.reset(clock())
 
     t0 = clock()
     n = len(workload)
@@ -188,13 +216,18 @@ def run_poisson(
             )
             i += 1
         if engine.busy:
-            engine.step()
+            if watchdog is not None:
+                with watchdog.watch():
+                    engine.step()
+            else:
+                engine.step()
         elif i < n:
             # idle until the next arrival (open loop — do not pull it in
             # early; the arrival process IS the experiment)
             time.sleep(
                 min(0.002, max(0.0, float(workload.arrivals[i]) - now))
             )
+    engine.finalize_trace()  # flush the final partial serve_window
     reqs = engine._completed[:]
     makespan = max(r.done_time for r in reqs) - t0 if reqs else 0.0
     record = _summarize(

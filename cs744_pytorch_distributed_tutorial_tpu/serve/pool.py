@@ -32,6 +32,12 @@ class PagePool:
     _free: list[int] = field(default_factory=list)
     _allocated: int = 0
     high_water: int = 0
+    # Cumulative churn counters (graftserve pool telemetry,
+    # obs/serve_trace.py): pages handed out / returned over the pool's
+    # lifetime — their per-window delta is the allocation pressure the
+    # serve_window records report as ``page_churn``.
+    total_allocs: int = 0
+    total_frees: int = 0
 
     def __post_init__(self) -> None:
         if self.num_pages < 2:
@@ -68,6 +74,7 @@ class PagePool:
             )
         out = [self._free.pop() for _ in range(n)]
         self._allocated += n
+        self.total_allocs += n
         self.high_water = max(self.high_water, self._allocated)
         return out
 
@@ -82,3 +89,4 @@ class PagePool:
         # Freed pages go back on TOP of the stack — reused first.
         self._free.extend(reversed(pages))
         self._allocated -= len(pages)
+        self.total_frees += len(pages)

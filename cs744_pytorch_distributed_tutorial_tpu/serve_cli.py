@@ -14,6 +14,12 @@ and ``--metrics-dir`` (docs/serving.md):
     python -m cs744_pytorch_distributed_tutorial_tpu.serve_cli \
         --requests 8 --parity-check
 
+    # graftserve: Perfetto span timeline + windowed SLO records +
+    # device-time attribution of the decode/prefill programs
+    # (docs/observability.md; obs serve-report renders/checks it):
+    python -m cs744_pytorch_distributed_tutorial_tpu.serve_cli \
+        --requests 24 --trace-dir /tmp/serve_trace --window-every 0.25
+
 Params are randomly initialized — serving latency/throughput and the
 parity contract are weight-independent, so the CLI does not train.
 """
@@ -83,6 +89,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "on any mismatch")
     p.add_argument("--metrics-dir", default=None,
                    help="also write records to METRICS_DIR/metrics.jsonl")
+    # graftserve observability (obs/serve_trace.py, docs/observability.md)
+    p.add_argument("--trace-dir", default=None,
+                   help="write graftserve artifacts here: the Perfetto "
+                        "trace (serve_trace.json), span/window/request "
+                        "JSONL, and serve_phases.json (device-time + "
+                        "roofline attribution of the decode/prefill "
+                        "programs)")
+    p.add_argument("--window-every", type=float, default=None, metavar="S",
+                   help="emit kind:'serve_window' SLO records every S "
+                        "seconds of the measured run (rolling TTFT/ITL "
+                        "p50/p99, queue depth, preemption rate, pool "
+                        "counters); defaults to 0.25 when --trace-dir "
+                        "is set")
     return p
 
 
@@ -196,8 +215,48 @@ def main(argv: list[str] | None = None) -> None:
             })
             failed |= mismatches > 0
 
-        engine = ServingEngine(model, params, cfg, sink=sink)
-        serve_rec = run_poisson(engine, workload, sink=sink)
+        tracer = None
+        window_every = args.window_every
+        if args.trace_dir and window_every is None:
+            window_every = 0.25
+        if args.trace_dir or window_every is not None:
+            from cs744_pytorch_distributed_tutorial_tpu.obs.serve_trace import (
+                ServeTracer,
+            )
+
+            tracer = ServeTracer(
+                args.num_slots, window_every_s=window_every
+            )
+        engine = ServingEngine(model, params, cfg, sink=sink, tracer=tracer)
+        # Flight recorder over the serving loop: SIGTERM/uncaught-crash
+        # dumps the serve event ring tail + pool high-water through the
+        # sink — same discipline the training engines get.
+        flight = engine.make_flight_recorder()
+        flight.install()
+        try:
+            serve_rec = run_poisson(engine, workload, sink=sink)
+        finally:
+            flight.uninstall()
+
+        if args.trace_dir:
+            import os
+
+            from cs744_pytorch_distributed_tutorial_tpu.obs.serve_trace import (
+                profile_serve_programs,
+            )
+
+            tracer.write(args.trace_dir)
+            # Post-run on purpose: profiling re-runs + AOT-compiles the
+            # programs, which must stay outside the measured (0-retrace)
+            # section.
+            phase_recs = profile_serve_programs(engine)
+            for rec in phase_recs:
+                sink.emit(rec)
+            with open(
+                os.path.join(args.trace_dir, "serve_phases.json"),
+                "w", encoding="utf-8",
+            ) as f:
+                json.dump(phase_recs, f, indent=1)
 
         if args.compare_baseline or args.gate:
             pool_tokens = cfg.num_pages * cfg.page_size

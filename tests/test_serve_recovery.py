@@ -252,6 +252,84 @@ def test_loadgen_reports_recovered_twin(tiny_lm):
     assert len(twins) == 1 and twins[0]["value"] == recovered
 
 
+def test_summarize_itl_excludes_kill_gap():
+    """A recovered request's resume boundary marks where the clock
+    epoch restarted: the diff across it "measures" the kill gap, not an
+    inter-token latency, and must be excluded from ITL percentiles —
+    while every real gap (including preemption stalls) still counts."""
+    from cs744_pytorch_distributed_tutorial_tpu.serve.loadgen import (
+        _summarize,
+    )
+
+    def req(token_times, boundaries):
+        r = Request(prompt=np.ones((3,), np.int32), max_new_tokens=4)
+        r.req_id = 0
+        r.orig_prompt_len = 3
+        r.orig_max_new_tokens = len(token_times)
+        r.generated = [1] * len(token_times)
+        r.arrival_time = token_times[0] - 0.001
+        r.submit_time = r.arrival_time
+        r.first_token_time = token_times[0]
+        r.done_time = token_times[-1]
+        r.token_times = list(token_times)
+        r.resume_boundaries = list(boundaries)
+        r.recovered = bool(boundaries)
+        return r
+
+    # 10 ms gaps with a 5 s kill gap before index-2's token
+    times = [0.0, 0.010, 5.010, 5.020, 5.030]
+    clean = _summarize("continuous", [req(times, [2])], 1.0, {})
+    assert clean["itl_p50_ms"] == pytest.approx(10.0, abs=0.01)
+    assert clean["itl_p99_ms"] == pytest.approx(10.0, abs=0.01)
+    # without the boundary the kill gap poisons the tail
+    dirty = _summarize("continuous", [req(times, [])], 1.0, {})
+    assert dirty["itl_p99_ms"] > 1000.0
+    # out-of-range boundaries (0, past the end) are ignored, not an error
+    edge = _summarize(
+        "continuous", [req(times, [0, 2, 99])], 1.0, {}
+    )
+    assert edge["itl_p99_ms"] == clean["itl_p99_ms"]
+
+
+@pytest.mark.slow  # chaos-smoke CI runs these without the tier-1 filter
+def test_resumed_requests_flag_recovered_and_bound_itl(tiny_lm):
+    """End to end: resume sets the boundary at the replayed stream
+    position, the per-request record carries recovered=True, and the
+    run's ITL percentiles exclude the (here: artificial) kill gap."""
+    model, params = tiny_lm
+    cfg = _cfg(seed=3)
+    victim = ServingEngine(model, params, cfg)
+    _submit_cases(victim)
+    for _ in range(4):
+        victim.step()
+    snap = victim.snapshot()
+    # in-flight requests carry their pre-kill token_times into the
+    # snapshot; fake a long outage so the kill gap is unmistakable
+    for rec in snap.requests:
+        rec["token_times"] = [t - 120.0 for t in rec["token_times"]]
+        if rec.get("arrival_time") is not None:
+            rec["arrival_time"] -= 120.0
+    del victim
+
+    sink = _ListSink()
+    fresh = ServingEngine(model, params, cfg, sink=sink)
+    resumed = fresh.resume(snap)
+    fresh.run()
+    streamed = [r for r in resumed if len(r.token_times) > 1]
+    assert any(r.resume_boundaries for r in streamed)
+    assert all(r.recovered for r in resumed)
+    recs = [r for r in sink.records if r.get("event") == "request"]
+    assert recs and all(r["recovered"] for r in recs)
+
+    from cs744_pytorch_distributed_tutorial_tpu.serve.loadgen import (
+        _summarize,
+    )
+
+    summary = _summarize("continuous", resumed, 1.0, {})
+    # the 120 s fake outage must not appear in the ITL tail
+    assert summary["itl_p99_ms"] < 60_000.0
+
+
 def test_metrics_summary_counts_chaos_rows():
     """summarize() tallies the per-request lifecycle events and surfaces
     the recovered count from serve summaries (pure function — fed a
