@@ -50,6 +50,37 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
     wire = [r["grad_sync_bytes"] for r in steps
             if isinstance(r.get("grad_sync_bytes"), (int, float))]
     events = [r for r in records if r.get("kind") == "event"]
+    # Chaos/recovery attribution (docs/reliability.md): kind:"event"
+    # records are stamped with process_id/generation, so a merged
+    # multi-process stream (e.g. a rendezvous store's events.jsonl)
+    # summarizes into per-rank/per-generation "pN/gM" tags — which rank
+    # died, who re-elected, who restored, in which generation.
+    chaos_events: dict[str, dict[str, Any]] = {}
+    for r in events:
+        name = r.get("event")
+        if not isinstance(name, str):
+            continue
+        if not (
+            name.startswith("recovery_")
+            or name
+            in (
+                "chaos_inject",
+                "process_loss",
+                "worker_death",
+                "worker_exit",
+                "reelection",
+                "generation_start",
+                "run_complete",
+            )
+        ):
+            continue
+        row = chaos_events.setdefault(name, {"count": 0, "by": []})
+        row["count"] += 1
+        pid, gen = r.get("process_id"), r.get("generation")
+        if pid is not None or gen is not None:
+            tag = f"p{'-' if pid is None else pid}/g{'-' if gen is None else gen}"
+            if tag not in row["by"]:
+                row["by"].append(tag)
     # graftscope per-phase records (bench.py --phase-breakdown) plus the
     # serve-side kind:"serve_phase" twins (serve_cli --trace-dir): one
     # row per phase, keyed by name, latest record wins on repeat runs.
@@ -160,6 +191,7 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "mean_mfu": _mean(mfus),
         "total_grad_sync_bytes": sum(wire) if wire else None,
         "events": sorted({e.get("event") for e in events}),
+        "chaos_events": chaos_events,
         "phases": phases,
         "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
         "sync_compare": sync_compare,
@@ -202,6 +234,9 @@ def main(argv: list[str] | None = None) -> int:
         ("grad sync bytes (total)", summary["total_grad_sync_bytes"]),
         ("events", ", ".join(summary["events"]) or None),
     ]
+    for name, row in summary["chaos_events"].items():
+        by = f" ({', '.join(row['by'])})" if row["by"] else ""
+        rows.append((f"chaos {name}", f"{row['count']}{by}"))
     for name, row in summary["phases"].items():
         rows.append((
             f"phase {name}",

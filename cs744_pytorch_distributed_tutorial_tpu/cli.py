@@ -138,6 +138,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--restart-backoff-s", type=float, default=0.0,
                    help="exponential backoff base between restarts "
                         "(attempt n sleeps backoff * 2^(n-1), capped 60s)")
+    p.add_argument("--restart-jitter", choices=("none", "decorrelated"),
+                   default="none",
+                   help="decorrelate restart backoff across ranks "
+                        "(seeded per process/generation) so survivors "
+                        "don't stampede the re-elected coordinator")
     # init_process mirror (master/part2a/part2a.py:80-85)
     p.add_argument("--coordinator", dest="coordinator_address", default=None,
                    help="coordinator address host:port (the --master-ip analog)")
@@ -226,14 +231,30 @@ def main(argv: list[str] | None = None) -> int:
     cfg = config_from_args(args)
 
     # Rendezvous before touching devices (multi-host no-op otherwise).
-    from cs744_pytorch_distributed_tutorial_tpu.parallel import initialize
-
-    initialize(
-        cfg.coordinator_address,
-        cfg.num_processes,
-        cfg.process_id,
-        auto=args.distributed,
+    # Under the graftelastic supervisor (launch.py) the coordinates
+    # arrive via the GRAFT_ELASTIC_* environment instead of flags —
+    # attach() also starts heartbeats and pins the identity labels.
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+        attach,
+        env_context,
     )
+
+    elastic_ctx = env_context()
+    if (
+        elastic_ctx is not None
+        and cfg.coordinator_address is None
+        and not args.distributed
+    ):
+        attach(elastic_ctx)
+    else:
+        from cs744_pytorch_distributed_tutorial_tpu.parallel import initialize
+
+        initialize(
+            cfg.coordinator_address,
+            cfg.num_processes,
+            cfg.process_id,
+            auto=args.distributed,
+        )
 
     from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
 
@@ -258,6 +279,8 @@ def main(argv: list[str] | None = None) -> int:
             trainer,
             max_restarts=args.max_restarts,
             backoff_s=args.restart_backoff_s,
+            backoff_jitter=args.restart_jitter,
+            jitter_seed=cfg.seed,
         )
         if restarts:
             print(f"recovered after {restarts} restart(s)")

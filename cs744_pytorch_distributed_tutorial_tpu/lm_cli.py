@@ -190,6 +190,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--restart-backoff-s", type=float, default=0.0,
                    help="exponential backoff base between restarts "
                         "(attempt n sleeps backoff * 2^(n-1), capped 60s)")
+    p.add_argument("--restart-jitter", choices=("none", "decorrelated"),
+                   default="none",
+                   help="decorrelate restart backoff across ranks "
+                        "(seeded per process/generation) so survivors "
+                        "don't stampede the re-elected coordinator")
     # data
     p.add_argument("--text-file", default=None,
                    help="byte-level corpus from a local file (vocab 256); "
@@ -460,6 +465,18 @@ def main(argv: list[str] | None = None) -> int:
     import jax
     import numpy as np
 
+    # Under the graftelastic supervisor (launch.py) the multi-process
+    # coordinates arrive via the GRAFT_ELASTIC_* environment — attach
+    # before any device use (rendezvous + heartbeats + identity labels).
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+        attach,
+        env_context,
+    )
+
+    elastic_ctx = env_context()
+    if elastic_ctx is not None:
+        attach(elastic_ctx)
+
     from cs744_pytorch_distributed_tutorial_tpu.data import (
         BYTE_VOCAB,
         byte_corpus,
@@ -566,6 +583,8 @@ def main(argv: list[str] | None = None) -> int:
             trainer,
             max_restarts=args.max_restarts,
             backoff_s=args.restart_backoff_s,
+            backoff_jitter=args.restart_jitter,
+            jitter_seed=args.seed,
             fit_args=(tokens,),
             fit_kwargs={"steps": args.steps},
         )

@@ -286,5 +286,19 @@ class Telemetry:
             "event": event,
             "time": time.time(),
         }
+        # process_id/generation attribution so merged multi-process
+        # event streams stay per-rank attributable; explicit fields
+        # (an already-stamped record forwarded by utils/failure.py)
+        # win over the re-resolved labels.
+        try:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+                runtime_labels,
+            )
+
+            labels = runtime_labels()
+            record["process_id"] = labels["process_id"]
+            record["generation"] = labels["generation"]
+        except Exception:  # stamping must never break telemetry
+            pass
         record.update(fields)
         self._sink.emit(record)

@@ -54,6 +54,22 @@ def initialize(
     )
     if not (auto or explicit):
         return
+    # Cross-process collectives on the CPU backend need an explicit
+    # collectives implementation (XLA:CPU otherwise rejects multiprocess
+    # computations outright). Opt into gloo before the backend
+    # initializes — but only when the platform is pinned to cpu and the
+    # user hasn't already chosen an implementation (e.g. mpi).
+    try:
+        platforms = jax.config.values.get("jax_platforms")
+        impl = jax.config.values.get("jax_cpu_collectives_implementation")
+        if (
+            platforms
+            and "cpu" in str(platforms).split(",")
+            and impl in (None, "", "none")
+        ):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # flag absent on other jax versions: best effort
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

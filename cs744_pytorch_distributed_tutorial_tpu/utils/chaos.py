@@ -17,6 +17,17 @@ at deterministic points in a run:
 - ``"sigterm"`` — real ``SIGTERM`` to this process (preemption notice).
   Under ``trap_sigterm`` the signal re-enters the run as a
   ``TrainingFailure`` so the same restart ladder handles it.
+- ``"process_kill"`` — real ``SIGKILL`` to a scheduled GLOBAL rank
+  (machine death; un-trappable by design). The spec names the target
+  (``{"kind": "process_kill", "rank": 2}``) and the monkey is told its
+  own rank at construction: only the matching rank dies, every other
+  rank proceeds into the step and discovers the death through the
+  collective watchdog + supervisor re-election
+  (``parallel/multihost.py``). Killing rank 0 exercises coordinator
+  re-election. Because the spec is keyed by *cumulative* step index and
+  targets a global rank, a re-exec'd survivor that re-parses the same
+  schedule can never re-fire it — the dead rank is absent from the new
+  generation.
 
 Faults live in a ``FaultSchedule`` keyed by *cumulative* train-step call
 index — the counter spans restarts, so a schedule "fault at call 3"
@@ -48,7 +59,7 @@ from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
 )
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
 
-FAULT_KINDS = ("nan", "device_loss", "sigterm")
+FAULT_KINDS = ("nan", "device_loss", "sigterm", "process_kill")
 
 
 class SigtermFailure(TrainingFailure):
@@ -75,6 +86,11 @@ class FaultSchedule:
                     f"fault kind must be one of {FAULT_KINDS}, got "
                     f"{spec.get('kind')!r} at call {idx}"
                 )
+            if spec["kind"] == "process_kill" and "rank" not in spec:
+                raise ValueError(
+                    f'process_kill at call {idx} needs a target: '
+                    f'{{"kind": "process_kill", "rank": <global rank>}}'
+                )
             self.faults[int(idx)] = dict(spec)
 
     @classmethod
@@ -86,6 +102,7 @@ class FaultSchedule:
         kinds: tuple[str, ...] = ("nan",),
         first_call: int = 1,
         lost: tuple[int, ...] = (),
+        kill_rank: int | None = None,
     ) -> "FaultSchedule":
         """Randomized-but-reproducible schedule: each call index in
         ``[first_call, n_calls)`` faults with probability ``rate``, kind
@@ -99,6 +116,8 @@ class FaultSchedule:
                 spec: dict[str, Any] = {"kind": kind}
                 if kind == "device_loss" and lost:
                     spec["lost"] = tuple(lost)
+                if kind == "process_kill":
+                    spec["rank"] = 0 if kill_rank is None else int(kill_rank)
                 faults[idx] = spec
         return cls(faults)
 
@@ -116,11 +135,27 @@ class ChaosMonkey:
     cumulative across restarts AND across re-meshes (``install`` the
     same monkey on the replacement trainer — ``run_chaos`` does this
     automatically). ``injected`` records ``(call_index, kind)`` for
-    assertions."""
+    assertions.
 
-    def __init__(self, schedule: FaultSchedule, telemetry: Any = None):
+    ``rank`` is this process's GLOBAL rank for ``process_kill``
+    targeting (faults aimed at another rank are skipped silently);
+    ``first_call`` offsets the cumulative index for a process that
+    resumed mid-run — a re-exec'd survivor starting at step K passes
+    ``first_call=K`` so the schedule keys keep meaning absolute step
+    indices across generations."""
+
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        telemetry: Any = None,
+        *,
+        rank: int | None = None,
+        first_call: int = 0,
+    ):
         self.schedule = schedule
         self.telemetry = telemetry
+        self.rank = rank
+        self.first_call = int(first_call)
         self.calls = 0  # cumulative train_step invocations, all restarts
         self.injected: list[tuple[int, str]] = []
         self._log = get_logger()
@@ -137,10 +172,23 @@ class ChaosMonkey:
         orig = trainer.train_step
 
         def chaotic_step(*args, **kwargs):
-            idx = self.calls
+            idx = self.first_call + self.calls
             self.calls += 1
             fault = self.schedule.pop(idx)
             kind = fault["kind"] if fault else None
+            if kind == "process_kill":
+                if self.rank is not None and int(fault["rank"]) == self.rank:
+                    self._inject(idx, kind)
+                    # SIGKILL cannot be trapped or flushed-after: the
+                    # injection event above must already be durable
+                    # (JsonlSink flushes per record; the rendezvous
+                    # store appends line-atomically).
+                    os.kill(os.getpid(), signal.SIGKILL)
+                # Another rank's death (or a re-parsed schedule whose
+                # target is already dead): not our fault to fire. The
+                # step proceeds and the collective watchdog reports
+                # what the peer's SIGKILL did to it.
+                kind = None
             if kind == "device_loss":
                 self._inject(idx, kind)
                 raise DeviceLossError(step=idx, lost=fault.get("lost", ()))

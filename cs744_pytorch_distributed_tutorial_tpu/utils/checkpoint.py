@@ -96,7 +96,17 @@ def place_host_leaf(leaf, like):
             # shard to host and re-upload for nothing, and the
             # donation-pairing guarantee above already holds.
             return leaf
-        return jax.device_put(np.asarray(leaf), like.sharding)
+        arr = np.asarray(leaf)
+        if not like.sharding.is_fully_addressable:
+            # Multi-process template (elastic restore after a re-exec):
+            # device_put rejects process-spanning shardings. Build the
+            # global array from this process's addressable shards — the
+            # host copy is the full global value on every process, so
+            # indexing by shard is exact.
+            return jax.make_array_from_callback(
+                arr.shape, like.sharding, lambda idx: arr[idx]
+            )
+        return jax.device_put(arr, like.sharding)
     return leaf
 
 

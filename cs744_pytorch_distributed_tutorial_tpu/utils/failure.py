@@ -45,6 +45,8 @@ import threading
 import time
 from typing import Any, Callable
 
+import numpy as np
+
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
 
 
@@ -78,6 +80,30 @@ class DeviceLossError(TrainingFailure):
         )
         self.step = step
         self.lost = lost
+
+
+class ProcessLossError(TrainingFailure):
+    """A peer PROCESS died mid-run (SIGKILLed rank, dead host).
+
+    The process-level analog of ``DeviceLossError``: retrying inside
+    this generation cannot succeed — every cross-process collective
+    still references the dead rank's address. The survivors must leave
+    the generation (``parallel/multihost.py``'s supervisor re-execs them
+    into generation g+1 on the shrunk world) and resume from the newest
+    durable tier. Raised by ``CollectiveWatchdog.check()`` between
+    steps; a survivor blocked INSIDE a collective cannot catch anything,
+    so the in-collective path exits with ``EXIT_PROCESS_LOSS`` instead.
+    ``dead`` carries the dead GLOBAL ranks the membership store
+    reported."""
+
+    def __init__(self, generation: int = 0, dead=()):
+        dead = tuple(int(r) for r in dead)
+        super().__init__(
+            f"process loss in generation {generation}"
+            + (f" (dead ranks {list(dead)})" if dead else "")
+        )
+        self.generation = generation
+        self.dead = dead
 
 
 class StepWatchdog:
@@ -286,13 +312,36 @@ class StepWatchdog:
             self.on_hang(elapsed_s)
 
 
+def _identity_fields() -> dict[str, int]:
+    """``process_id``/``generation`` stamps for event records, so a
+    multi-process recovery timeline is attributable per rank (merged
+    JSONL streams are otherwise ambiguous the moment a second rank
+    writes). Resolved lazily through ``parallel/multihost.py`` — the
+    labels re-resolve after each ``jax.distributed`` re-initialization,
+    never touching an uninitialized jax backend."""
+    try:
+        from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+            runtime_labels,
+        )
+
+        labels = runtime_labels()
+        return {
+            "process_id": labels["process_id"],
+            "generation": labels["generation"],
+        }
+    except Exception:  # identity stamping must never break recovery
+        return {}
+
+
 def emit_event(target: Any, event: str, **fields: Any) -> None:
     """Put one ``kind:"event"`` record on ``target``: either a
     ``Telemetry`` (``obs/metrics.py``, has ``emit_event``) or a raw sink
     (``obs/sinks.py``, has ``emit``). None is a no-op — recovery never
-    depends on telemetry being configured."""
+    depends on telemetry being configured. Every record is stamped with
+    ``process_id``/``generation`` (explicit fields win)."""
     if target is None:
         return
+    fields = {**_identity_fields(), **fields}
     if hasattr(target, "emit_event"):
         target.emit_event(event, **fields)
     else:
@@ -310,6 +359,9 @@ def run_with_recovery(
     backoff_s: float = 0.0,
     backoff_factor: float = 2.0,
     max_backoff_s: float = 60.0,
+    backoff_jitter: str = "none",
+    jitter_seed: int | None = None,
+    jitter_rng: Any = None,
     sleep: Callable[[float], None] = time.sleep,
     telemetry: Any = None,
     remesh: Callable[[Any, TrainingFailure], Any] | None = None,
@@ -334,6 +386,17 @@ def run_with_recovery(
     ``max_backoff_s``) — in a real deployment the fault is usually
     environmental and hammering the restart path makes it worse.
     ``sleep`` is injectable for tests.
+
+    ``backoff_jitter="decorrelated"`` switches to decorrelated jitter
+    (attempt n sleeps ``uniform(backoff_s, prev * 3)``, capped at
+    ``max_backoff_s``): after a process loss, N surviving ranks all
+    restart at once, and deterministic exponential backoff keeps them in
+    lockstep — every survivor hammers the re-elected coordinator at the
+    same instant, every attempt. The jitter stream is seeded per
+    ``(jitter_seed, process_id, generation)`` so each rank draws a
+    DIFFERENT (but reproducible) sequence; pass ``jitter_rng`` to inject
+    the generator directly in tests. The default ``"none"`` keeps the
+    deterministic schedule bit-for-bit.
 
     A ``DeviceLossError`` escalates past retry: when ``remesh`` is
     given (``parallel/elastic.py::default_remesh``), it is called as
@@ -363,6 +426,22 @@ def run_with_recovery(
             "snapshot tier (trainer.memstore): restart-based recovery "
             "resumes from the newest recoverable state"
         )
+    if backoff_jitter not in ("none", "decorrelated"):
+        raise ValueError(
+            f'backoff_jitter must be "none" or "decorrelated", '
+            f"got {backoff_jitter!r}"
+        )
+    rng = jitter_rng
+    if backoff_jitter == "decorrelated" and rng is None:
+        identity = _identity_fields()
+        rng = np.random.default_rng(
+            (
+                0 if jitter_seed is None else int(jitter_seed),
+                identity.get("process_id", 0),
+                identity.get("generation", 0),
+            )
+        )
+    prev_delay = backoff_s
     kwargs = fit_kwargs or {}
     restarts = 0
     while True:
@@ -388,10 +467,21 @@ def run_with_recovery(
                 raise
             delay = 0.0
             if backoff_s > 0:
-                delay = min(
-                    backoff_s * backoff_factor ** (restarts - 1),
-                    max_backoff_s,
-                )
+                if backoff_jitter == "decorrelated":
+                    delay = min(
+                        float(
+                            rng.uniform(
+                                backoff_s, max(backoff_s, prev_delay * 3.0)
+                            )
+                        ),
+                        max_backoff_s,
+                    )
+                    prev_delay = delay
+                else:
+                    delay = min(
+                        backoff_s * backoff_factor ** (restarts - 1),
+                        max_backoff_s,
+                    )
             tier = "restart"
             if isinstance(e, DeviceLossError) and remesh is not None:
                 old_world = int(

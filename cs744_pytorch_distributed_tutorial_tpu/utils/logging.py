@@ -22,16 +22,32 @@ class _RankPrefixFilter(logging.Filter):
     The prefix must be computed per-record, not cached at handler
     creation: loggers are routinely created at import time, before
     ``jax.distributed`` initializes, and a cached prefix would then be
-    silently wrong (absent) for the rest of the run.
+    silently wrong (absent) for the rest of the run. Worse, it must
+    RE-resolve after an elastic re-initialization — a survivor that was
+    ``[proc 2/4]`` in generation 0 may be ``[proc 1/3]`` in generation
+    1, and a pre-generation prefix would mislabel every post-recovery
+    record. Resolution is delegated to
+    ``parallel/multihost.py::runtime_labels`` (explicit labels set at
+    each re-init > supervisor environment > jax, consulted only when
+    its backend is already up — asking earlier would *trigger* backend
+    initialization from a log line). Generations after the first carry
+    a ``gN`` suffix so interleaved per-generation logs stay separable.
     """
 
     def filter(self, record: logging.LogRecord) -> bool:
         try:
-            n = jax.process_count()
-            record.rank_prefix = (
-                f"[proc {jax.process_index()}/{n}] " if n > 1 else ""
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+                runtime_labels,
             )
-        except RuntimeError:  # backend not up yet
+
+            labels = runtime_labels()
+            n = labels["process_count"]
+            gen = labels["generation"]
+            suffix = f" g{gen}" if gen > 0 else ""
+            record.rank_prefix = (
+                f"[proc {labels['process_id']}/{n}{suffix}] " if n > 1 else ""
+            )
+        except Exception:  # logging must never fail on label resolution
             record.rank_prefix = ""
         return True
 

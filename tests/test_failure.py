@@ -296,3 +296,90 @@ def test_halt_on_nonfinite_can_be_disabled(mesh4):
     state, history = tr.fit()  # completes despite the injected NaN
     assert int(jnp.asarray(state.step)) == 4
     assert any(not np.isfinite(l) for _, _, l in history["train_loss"])
+
+
+# ---------------------------------------------------- restart jitter
+class _AlwaysFailingTrainer:
+    """Minimal run_with_recovery surface: restartable (checkpoint_dir
+    set) but every fit attempt fails — isolates the backoff schedule."""
+
+    class cfg:
+        checkpoint_dir = "unused"
+
+    memstore = None
+
+    def fit(self, *a, **k):
+        raise NonFiniteLossError(step=0, loss=float("nan"))
+
+
+def _backoff_sequence(restarts, **kwargs):
+    sleeps = []
+    with pytest.raises(NonFiniteLossError):
+        run_with_recovery(
+            _AlwaysFailingTrainer(),
+            max_restarts=restarts,
+            backoff_s=0.5,
+            sleep=sleeps.append,
+            **kwargs,
+        )
+    return sleeps
+
+
+def test_backoff_jitter_defaults_off():
+    """backoff_jitter is strictly opt-in: the default schedule stays the
+    bit-exact deterministic exponential."""
+    assert _backoff_sequence(2) == [0.5, 1.0]
+    assert _backoff_sequence(2, backoff_jitter="none") == [0.5, 1.0]
+
+
+def test_backoff_jitter_invalid_value_rejected():
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        run_with_recovery(
+            _AlwaysFailingTrainer(), backoff_jitter="thundering-herd"
+        )
+
+
+def test_decorrelated_jitter_bounds_and_injected_rng():
+    """Decorrelated jitter (AWS shape): attempt n draws
+    uniform(base, prev * 3) capped at max_backoff_s — every delay stays
+    within [base, cap], and an injected rng makes the draw exact."""
+    rng = np.random.default_rng(123)
+    sleeps = _backoff_sequence(
+        6, backoff_jitter="decorrelated", jitter_rng=rng,
+        max_backoff_s=3.0,
+    )
+    assert len(sleeps) == 6
+    assert all(0.5 <= s <= 3.0 for s in sleeps)
+
+    expect_rng = np.random.default_rng(123)
+    prev = 0.5
+    for got in sleeps:
+        want = min(float(expect_rng.uniform(0.5, max(0.5, prev * 3.0))), 3.0)
+        assert got == want
+        prev = want
+
+
+def test_decorrelated_jitter_seeded_per_rank_identity():
+    """The stream is seeded by (jitter_seed, process_id, generation):
+    same identity -> reproducible; different rank or generation ->
+    decorrelated (survivors don't restart in lockstep)."""
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+        reset_runtime_labels,
+        set_runtime_labels,
+    )
+
+    def seq(process_id, generation):
+        set_runtime_labels(
+            process_id=process_id, process_count=4,
+            generation=generation, global_rank=process_id,
+        )
+        try:
+            return _backoff_sequence(
+                4, backoff_jitter="decorrelated", jitter_seed=42
+            )
+        finally:
+            reset_runtime_labels()
+
+    assert seq(0, 0) == seq(0, 0)  # reproducible for one identity
+    assert seq(0, 0) != seq(1, 0)  # ranks decorrelate
+    assert seq(1, 0) != seq(1, 1)  # generations decorrelate

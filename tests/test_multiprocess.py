@@ -449,6 +449,89 @@ def test_ring_attention_across_two_processes(tmp_path):
     np.testing.assert_allclose(got, want, rtol=2e-5)
 
 
+_STEP_PARITY_WORKER = r"""
+import os, sys
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+sys.path.insert(0, {repo!r})
+from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+    initialize, shard_global_batch,
+)
+from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+rank = int(sys.argv[1])
+initialize({coord!r}, 2, rank)
+mesh = make_mesh({{"data": 2}}, devices=jax.devices())
+cfg = TrainConfig(model="tiny_cnn", sync="allreduce", sync_bn=True,
+                  augment=False, num_devices=2, global_batch_size=8,
+                  synthetic_data=True, synthetic_train_size=8,
+                  synthetic_test_size=8, seed=0)
+tr = Trainer(cfg, mesh=mesh)
+state = tr.init()
+ds = synthetic_cifar10(8, 8, seed=0)
+x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+key = jax.random.key(cfg.seed)
+losses = []
+for _ in range(3):
+    state, m = tr.train_step(state, x, y, key)
+    losses.append(round(float(jax.device_get(m["loss"])), 8))
+print(f"rank {{rank}} stepparity ok losses={{losses}}")
+"""
+
+
+def test_train_step_psum_parity_across_two_processes(tmp_path):
+    """The elastic demo worker's exact step recipe (tiny-CNN allreduce,
+    sync_bn, fixed batch, trainer-folded PRNG) over a REAL process
+    boundary: the grad psum and BN-stat psum cross the inter-process
+    transport, both ranks observe identical losses, and the trajectory
+    matches a single-process 2-virtual-device oracle — the parity claim
+    the graftelastic e2e builds on, isolated from the launcher."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outs = _run_pair(_STEP_PARITY_WORKER, tmp_path, repo, "stepparity ok")
+    loss_lines = [
+        next(l for l in out.splitlines() if "losses=" in l) for out in outs
+    ]
+    assert loss_lines[0].split("losses=")[1] == loss_lines[1].split(
+        "losses="
+    )[1], loss_lines
+
+    import ast
+
+    import jax
+    import numpy as np
+
+    from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
+    from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
+    from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
+        shard_global_batch,
+    )
+    from cs744_pytorch_distributed_tutorial_tpu.train import Trainer
+
+    cfg = TrainConfig(model="tiny_cnn", sync="allreduce", sync_bn=True,
+                      augment=False, num_devices=2, global_batch_size=8,
+                      synthetic_data=True, synthetic_train_size=8,
+                      synthetic_test_size=8, seed=0)
+    mesh = make_mesh({"data": 2}, devices=jax.devices()[:2])
+    tr = Trainer(cfg, mesh=mesh)
+    state = tr.init()
+    ds = synthetic_cifar10(8, 8, seed=0)
+    x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
+    key = jax.random.key(cfg.seed)
+    oracle = []
+    for _ in range(3):
+        state, m = tr.train_step(state, x, y, key)
+        oracle.append(float(jax.device_get(m["loss"])))
+    got = ast.literal_eval(loss_lines[0].split("losses=")[1])
+    np.testing.assert_allclose(got, oracle, rtol=2e-5)
+
+
 _ZERO_WORKER = r"""
 import os, sys
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
