@@ -81,6 +81,12 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
             tag = f"p{'-' if pid is None else pid}/g{'-' if gen is None else gen}"
             if tag not in row["by"]:
                 row["by"].append(tag)
+        # recovery_giveup carries the full traceback of the fatal
+        # failure (utils/failure.py, serve/guard.py); surface the last
+        # non-empty line — the exception itself — as the row's tail.
+        tb = r.get("traceback")
+        if isinstance(tb, str) and tb.strip():
+            row["traceback_tail"] = tb.strip().splitlines()[-1].strip()
     # graftscope per-phase records (bench.py --phase-breakdown) plus the
     # serve-side kind:"serve_phase" twins (serve_cli --trace-dir): one
     # row per phase, keyed by name, latest record wins on repeat runs.
@@ -133,8 +139,22 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
                           "itl_p50_ms", "itl_p99_ms",
                           "tokens_per_sec", "page_high_water",
                           "slot_occupancy", "preemptions",
-                          "recovered_requests")
+                          "recovered_requests",
+                          "completed", "rejected", "timed_out",
+                          "recovered", "restarts")
             }
+    # graftguard overload shedding (serve/guard.py): kind:"serve_shed"
+    # records aggregated by machine-readable reason; terminal sheds
+    # (rejections) counted apart from non-terminal ones (degrade trims).
+    serve_shed: dict[str, int] = {}
+    shed_terminal = 0
+    for r in records:
+        if r.get("kind") == "serve_shed":
+            reason = r.get("reason")
+            if isinstance(reason, str):
+                serve_shed[reason] = serve_shed.get(reason, 0) + 1
+            if r.get("terminal"):
+                shed_terminal += 1
     # graftserve windowed SLO telemetry (obs/serve_trace.py): one
     # aggregate row over every kind:"serve_window" record — TTFT/ITL
     # p99 trajectory (last + worst window), peak pool occupancy, queue
@@ -196,6 +216,8 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "sync_exposed_ms": sync_exposed[-1] if sync_exposed else None,
         "sync_compare": sync_compare,
         "serve": serve,
+        "serve_shed": serve_shed,
+        "serve_shed_terminal": shed_terminal,
         "serve_windows": serve_windows,
         "serve_decode_host_exposed_ms": (
             host_exposed[-1] if host_exposed else None
@@ -236,7 +258,9 @@ def main(argv: list[str] | None = None) -> int:
     ]
     for name, row in summary["chaos_events"].items():
         by = f" ({', '.join(row['by'])})" if row["by"] else ""
-        rows.append((f"chaos {name}", f"{row['count']}{by}"))
+        tail = row.get("traceback_tail")
+        tail = f" — {tail}" if tail else ""
+        rows.append((f"chaos {name}", f"{row['count']}{by}{tail}"))
     for name, row in summary["phases"].items():
         rows.append((
             f"phase {name}",
@@ -249,6 +273,16 @@ def main(argv: list[str] | None = None) -> int:
     for label, row in summary["serve"].items():
         occ = row.get("slot_occupancy")
         recovered = row.get("recovered_requests")
+        # Terminal-status accounting (serve/guard.py): shown whenever
+        # any request ended other than plain-completed.
+        statuses = ""
+        if row.get("rejected") or row.get("timed_out") or row.get("recovered"):
+            statuses = (
+                f", done/shed/expired/recovered "
+                f"{_fmt(row.get('completed'))}/{_fmt(row.get('rejected'))}/"
+                f"{_fmt(row.get('timed_out'))}/{_fmt(row.get('recovered'))}"
+            )
+        restarts = row.get("restarts")
         rows.append((
             f"serve {label}",
             f"{_fmt(row['requests'])} reqs, TTFT p50/p99 "
@@ -258,7 +292,17 @@ def main(argv: list[str] | None = None) -> int:
             f"{_fmt(row['tokens_per_sec'])} tok/s, pages hw "
             f"{_fmt(row.get('page_high_water'))}, occupancy "
             f"{_fmt(round(occ, 3) if isinstance(occ, float) else occ)}"
-            + (f", recovered {_fmt(recovered)}" if recovered else ""),
+            + (f", recovered {_fmt(recovered)}" if recovered else "")
+            + statuses
+            + (f", restarts {_fmt(restarts)}" if restarts else ""),
+        ))
+    if summary["serve_shed"]:
+        by_reason = ", ".join(
+            f"{k}={v}" for k, v in sorted(summary["serve_shed"].items())
+        )
+        rows.append((
+            "serve shed",
+            f"{by_reason} ({summary['serve_shed_terminal']} terminal)",
         ))
     sw = summary["serve_windows"]
     if sw:

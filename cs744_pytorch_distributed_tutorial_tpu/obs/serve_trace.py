@@ -62,7 +62,11 @@ __all__ = [
 # Admission span kinds: how a request's KV got (re)built in its slot.
 PREFILL_KINDS = frozenset({"prefill", "recompute", "resume-replay"})
 _INTERVAL_KINDS = PREFILL_KINDS | {"queue", "decode_run"}
-_INSTANT_KINDS = frozenset({"preempt", "retire"})
+# "shed" is terminal like "retire", but for a request REJECTED at
+# admission control (serve/guard.py) — it never queued, so its whole
+# lifecycle is the one instant. "retire" instants carry a ``status``
+# field when the disposition is not "completed" (e.g. "timed_out").
+_INSTANT_KINDS = frozenset({"preempt", "retire", "shed"})
 
 TRACE_NAME = "serve_trace.json"
 SPANS_NAME = "serve_spans.jsonl"
@@ -140,6 +144,8 @@ class ServeTracer:
         self._steps_w = 0
         self._occ_w = 0
         self._queue_max_w = 0
+        self._timeout_w = 0
+        self._shed_w = 0
         self._prefill_w: dict[int, int] = {}
 
     def _seen(self, t: float) -> None:
@@ -259,6 +265,40 @@ class ServeTracer:
         })
         self._preempt_w += 1
 
+    def on_crash(self, now: float) -> None:
+        """Engine death under supervised recovery (serve/guard.py):
+        seal every open span at the crash instant. The tracer outlives
+        the engine generation, so without this the next generation's
+        first decode step would extend the dead slots' open runs to
+        post-resume timestamps, overlapping the resumed requests' new
+        queue spans."""
+        self._seen(float(now))
+        for slot in sorted(self._open_run):
+            self._close_run(slot)  # t1 already stamped at the last step
+        for q in self._open_queue.values():
+            q["t1"] = float(now)
+            self.spans.append(q)
+        self._open_queue.clear()
+
+    def on_shed(self, req: Any, now: float, reason: str) -> None:
+        """Terminal rejection at admission control (serve/guard.py):
+        the request never queued, so its whole lifecycle is this one
+        ``shed`` instant."""
+        self._seen(float(now))
+        self.spans.append({
+            "name": "shed", "req": int(req.req_id), "slot": None,
+            "t0": float(now), "t1": float(now), "reason": str(reason),
+        })
+        self._shed_w += 1
+        self.requests.append({
+            "req": int(req.req_id),
+            "status": "rejected",
+            "reason": str(reason),
+            "tokens": 0,
+            "preemptions": 0,
+            "recovered": False,
+        })
+
     def on_retire(self, req: Any, slot: int | None, now: float) -> None:
         self._seen(float(now))
         if slot is not None:
@@ -267,11 +307,17 @@ class ServeTracer:
         if q is not None:  # finished while queued (budget spent at preempt)
             q["t1"] = float(now)
             self.spans.append(q)
-        self.spans.append({
+        retire_span: dict[str, Any] = {
             "name": "retire", "req": int(req.req_id),
             "slot": None if slot is None else int(slot),
             "t0": float(now), "t1": float(now),
-        })
+        }
+        status = getattr(req, "status", None)
+        if status not in (None, "completed"):
+            retire_span["status"] = status
+        if status == "timed_out":
+            self._timeout_w += 1
+        self.spans.append(retire_span)
         self._done_w += 1
         rec: dict[str, Any] = {
             "req": int(req.req_id),
@@ -279,6 +325,8 @@ class ServeTracer:
             "preemptions": int(req.preemptions),
             "recovered": bool(getattr(req, "recovered", False)),
         }
+        if status not in (None, "completed"):
+            rec["status"] = status
         if req.first_token_time is not None and req.arrival_time is not None:
             rec["ttft_ms"] = (req.first_token_time - req.arrival_time) * 1e3
         if len(req.token_times) > 1:
@@ -328,6 +376,8 @@ class ServeTracer:
             "decode_steps": self._steps_w,
             "preemptions": self._preempt_w,
             "preempt_rate_per_s": round(self._preempt_w / width, 3),
+            "timed_out": self._timeout_w,
+            "shed": self._shed_w,
             "queue_depth": int(queue_depth),
             "queue_depth_max": self._queue_max_w,
             "slot_occupancy": round(
@@ -503,10 +553,17 @@ def check_spans(
                     f"req {rid}: {sp['name']} not preceded by a queue span"
                 )
         retires = [s for s in sps if s["name"] == "retire"]
+        sheds = [s for s in sps if s["name"] == "shed"]
         if len(retires) > 1:
             problems.append(f"req {rid}: {len(retires)} retire instants")
+        if sheds and (retires or closed):
+            # Shed happens at admission control, before the request ever
+            # queues — a shed lifecycle is exactly one instant.
+            problems.append(
+                f"req {rid}: shed request has other lifecycle spans"
+            )
         if not retires:
-            if require_retired:
+            if require_retired and not sheds:
                 problems.append(f"req {rid}: never retired (orphan spans)")
         else:
             if closed:
@@ -515,7 +572,12 @@ def check_spans(
                     problems.append(
                         f"req {rid}: spans extend past the retire instant"
                     )
-            if not any(s["name"] in PREFILL_KINDS for s in closed):
+            if (
+                not any(s["name"] in PREFILL_KINDS for s in closed)
+                and retires[0].get("status") != "timed_out"
+            ):
+                # A queued-expiry retire legitimately has only a closed
+                # queue span: the request never reached a slot.
                 problems.append(
                     f"req {rid}: retired without an admission span"
                 )
@@ -540,6 +602,11 @@ def reconcile(
             by_req.setdefault(sp["req"], []).append(sp)
     for rec in requests:
         if rec.get("recovered"):
+            continue
+        if rec.get("status") in ("rejected", "timed_out"):
+            # Shed at admission (no spans at all) or expired before the
+            # first token (no admission span / no TTFT) — nothing to
+            # reconcile against.
             continue
         rid = rec["req"]
         sps = sorted(by_req.get(rid, []), key=lambda s: s["t0"])
@@ -627,6 +694,10 @@ def render_serve_report(data: dict[str, list[dict[str, Any]]]) -> str:
         ) or "-"),
         ("requests", str(len({s.get("req") for s in spans}))),
         ("retired", str(counts.get("retire", 0))),
+        ("shed", str(counts.get("shed", 0))),
+        ("timed out", str(sum(
+            1 for r in requests if r.get("status") == "timed_out"
+        ))),
         ("recovered", str(sum(1 for r in requests if r.get("recovered")))),
         ("windows", str(len(windows))),
     ]
@@ -690,6 +761,13 @@ def profile_serve_programs(
         # hand them the engine's live pools.
         return jax.tree.map(lambda x: x + 0, engine._pages)
 
+    # A ServeChaosMonkey wraps _decode_step in a plain function; unwrap
+    # to the jitted original — for .lower(), and so profiling re-runs
+    # never advance the monkey's fault counter.
+    decode_step = getattr(
+        engine._decode_step, "__wrapped__", engine._decode_step
+    )
+
     key = engine._sample_root
     dec_args = (
         jnp.zeros((b,), jnp.int32),
@@ -710,10 +788,10 @@ def profile_serve_programs(
     records: list[dict[str, Any]] = []
     dec_state = {"pages": fresh_pages()}
     prof = capture_device_profile(
-        _runner(engine._decode_step, dec_args, dec_state), iters=iters
+        _runner(decode_step, dec_args, dec_state), iters=iters
     )
     costs = compiled_costs(
-        engine._decode_step.lower(
+        decode_step.lower(
             engine.params, dec_state["pages"], *dec_args
         ).compile()
     )

@@ -14,6 +14,11 @@ from cs744_pytorch_distributed_tutorial_tpu.serve.engine import (  # noqa: F401
     ServeSnapshot,
     ServingEngine,
 )
+from cs744_pytorch_distributed_tutorial_tpu.serve.guard import (  # noqa: F401
+    GuardConfig,
+    ServeGuard,
+    run_serve_with_recovery,
+)
 from cs744_pytorch_distributed_tutorial_tpu.serve.loadgen import (  # noqa: F401
     Workload,
     make_poisson_workload,

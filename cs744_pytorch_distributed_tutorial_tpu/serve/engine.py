@@ -69,6 +69,9 @@ from cs744_pytorch_distributed_tutorial_tpu.infer.generate import (
     sample_tokens,
 )
 from cs744_pytorch_distributed_tutorial_tpu.serve.pool import PagePool
+from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
+    DecodeNanError,
+)
 
 # cache leaf -> pages leaf: the prefill commit scatters the dense cache
 # rows a prefill pass wrote into the slot's pages. Names mirror the
@@ -120,6 +123,17 @@ class Request:
     max_new_tokens: int
     req_id: int = -1
     arrival_time: float | None = None  # loadgen wall-clock; None = submit
+    # SLO budgets (serve/guard.py): ``deadline_s`` bounds TOTAL wall time
+    # from arrival to retire; ``max_queue_s`` bounds time spent queued
+    # before the FIRST admission. None defers to the guard's defaults
+    # (and stays unbounded when no guard is armed). Both survive
+    # snapshot/resume, so a recovered request keeps its original budget.
+    deadline_s: float | None = None
+    max_queue_s: float | None = None
+    # Terminal disposition, set exactly once by the engine when the
+    # request leaves the system: "completed" (budget/EOS), "rejected"
+    # (shed at admission control), or "timed_out" (deadline expiry).
+    status: str | None = None
     # engine-owned lifecycle state
     generated: list[int] = field(default_factory=list)
     submit_time: float = 0.0
@@ -154,6 +168,20 @@ class Request:
     def output_tokens(self) -> int:
         done = self.orig_max_new_tokens - self.max_new_tokens
         return done + len(self.generated)
+
+    @property
+    def terminal_status(self) -> str | None:
+        """One of ``completed`` / ``rejected`` / ``timed_out`` /
+        ``recovered`` once the request has left the system, else None.
+        ``recovered`` is a completed request that was replayed through a
+        ``ServeSnapshot`` resume — loadgen's terminal accounting keys
+        off this (every submitted request must reach exactly one)."""
+        status = self.status
+        if status is None and self.done_time is not None:
+            status = "completed"  # pre-guard paths (batch baseline)
+        if status == "completed" and self.recovered:
+            return "recovered"
+        return status
 
 
 @dataclass
@@ -212,6 +240,7 @@ class ServingEngine:
         clock: Callable[[], float] = time.monotonic,
         on_token: Callable[[Request, int], None] | None = None,
         tracer: Any = None,
+        guard: Any = None,
     ) -> None:
         check_decode_model(model, "serving", allow_tensor=mesh is not None)
         if cfg.num_slots < 1:
@@ -251,6 +280,11 @@ class ServingEngine:
         self.clock = clock
         self.on_token = on_token
         self.tracer = tracer
+        # serve/guard.py::ServeGuard — admission control (shed/degrade at
+        # submit) + deadline expiry (swept at the top of every step).
+        # Optional and host-side only: with no guard, behavior is
+        # byte-identical to the unguarded engine.
+        self.guard = guard
         self.pool = PagePool(cfg.num_pages, cfg.page_size)
         self.model = model.clone(
             page_size=cfg.page_size,
@@ -280,6 +314,8 @@ class ServingEngine:
         self._trash_rows = 0
         self._straggler: Any = None
         self._completed: list[Request] = []
+        self._timed_out = 0  # requests retired at deadline expiry
+        self._shed = 0  # requests rejected at admission control
         self._base_key = jax.random.key(cfg.seed)
         # One PRNG stream PER REQUEST, indexed by absolute output-token
         # position: token t of request r always samples from
@@ -531,6 +567,21 @@ class ServingEngine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {req.max_new_tokens}"
             )
+        # Ids assign BEFORE admission control, so a guarded run's
+        # req_ids line up with an unguarded oracle run of the same
+        # workload regardless of which requests shed — and the shed
+        # events themselves carry a real id.
+        if req.req_id < 0:
+            req.req_id = self._next_id
+            self._next_id += 1
+        # Admission control (serve/guard.py): may terminally REJECT the
+        # request (bounded queue; returned unqueued with
+        # status="rejected" and a serve_shed event already emitted) or
+        # DEGRADE it (trim max_new_tokens under pool pressure — before
+        # orig_max_new_tokens is recorded, so the trimmed budget IS the
+        # request's budget and its output stays an oracle prefix).
+        if self.guard is not None and not self.guard.admit(self, req):
+            return req
         if req.orig_prompt_len < 0:
             req.orig_prompt_len = int(req.prompt.size)
             req.orig_max_new_tokens = int(req.max_new_tokens)
@@ -552,9 +603,6 @@ class ServingEngine:
                 f"at {cap} pages — raise max_pages_per_slot/num_pages or "
                 "shrink the request"
             )
-        if req.req_id < 0:
-            req.req_id = self._next_id
-            self._next_id += 1
         req.submit_time = self.clock()
         if req.arrival_time is None:
             req.arrival_time = req.submit_time
@@ -719,6 +767,11 @@ class ServingEngine:
         self.pool.free(slot.pages)
         self._page_table[i, :] = 0
         self._slots[i] = None
+        if __debug__:
+            # Every page-freeing path (retire, preempt, deadline expiry)
+            # funnels through here — audit the free-list/live accounting
+            # at the moment a leak or double-lease would be introduced.
+            self.pool.check_invariants()
 
     def _ensure_pages(self, n: int) -> bool:
         """Make n pages allocatable, preempting LIFO as needed."""
@@ -800,35 +853,95 @@ class ServingEngine:
             self.cfg.eos_id is not None and slot.last_tok == self.cfg.eos_id
         )
 
-    def _retire(self, i: int) -> None:
+    def _retire(self, i: int, status: str = "completed") -> None:
         req = self._slots[i].req
         self._free_slot(i)
-        self._finish(req, slot=i)
+        self._finish(req, slot=i, status=status)
 
-    def _finish(self, req: Request, slot: int | None = None) -> None:
+    def _finish(
+        self, req: Request, slot: int | None = None,
+        status: str = "completed",
+    ) -> None:
+        req.status = status
         req.done_time = self.clock()
+        if status == "timed_out":
+            self._timed_out += 1
         self._completed.append(req)
         if self.tracer is not None:
             self.tracer.on_retire(req, slot, req.done_time)
-        ttft_ms = (req.first_token_time - req.arrival_time) * 1e3
-        queue_ms = (req.submit_time - req.arrival_time) * 1e3
-        decode_s = req.done_time - req.first_token_time
+        # A request that timed out while QUEUED never produced a token —
+        # its latency fields are honestly absent, not zero.
+        ttft_ms = None
+        decode_ms = None
         out = req.output_tokens
+        if req.first_token_time is not None:
+            ttft_ms = round(
+                (req.first_token_time - req.arrival_time) * 1e3, 3
+            )
+            decode_s = req.done_time - req.first_token_time
+            decode_ms = round(decode_s * 1e3 / max(1, out - 1), 4)
+        queue_ms = (req.submit_time - req.arrival_time) * 1e3
         self._emit({
             "kind": "serve",
             "event": "request",
             "time": time.time(),
             "id": req.req_id,
+            "status": req.terminal_status,
             "prompt_tokens": req.orig_prompt_len,
             "output_tokens": out,
             "queue_ms": round(queue_ms, 3),
-            "ttft_ms": round(ttft_ms, 3),
-            "decode_ms_per_token": round(
-                decode_s * 1e3 / max(1, out - 1), 4
-            ),
+            "ttft_ms": ttft_ms,
+            "decode_ms_per_token": decode_ms,
             "preemptions": req.preemptions,
             "recovered": req.recovered,
         })
+
+    def _shed_reject(self, req: Request, reason: str, **fields: Any) -> None:
+        """Terminally reject ``req`` at admission control: it never
+        queues, never touches the pool, and resolves immediately with
+        status ``rejected``. Called by the guard from inside
+        ``submit()``."""
+        now = self.clock()
+        req.submit_time = now
+        if req.arrival_time is None:
+            req.arrival_time = now
+        if req.orig_prompt_len < 0:
+            req.orig_prompt_len = int(req.prompt.size)
+            req.orig_max_new_tokens = int(req.max_new_tokens)
+        req.status = "rejected"
+        req.done_time = now
+        self._shed += 1
+        self._completed.append(req)
+        if self.tracer is not None:
+            self.tracer.on_shed(req, now, reason)
+        self._emit({
+            "kind": "serve_shed",
+            "time": time.time(),
+            "id": req.req_id,
+            "reason": reason,
+            "terminal": True,
+            **fields,
+        })
+
+    def _expire_request(self, req: Request, slot: int | None,
+                        reason: str) -> None:
+        """Retire ``req`` with terminal status ``timed_out``: an active
+        slot's pages free immediately (the invariant check in
+        ``_free_slot`` audits the reclamation), a queued request just
+        resolves. ``reason`` is the budget that expired (``deadline`` or
+        ``queue_wait``)."""
+        self._emit({
+            "kind": "serve",
+            "event": "timed_out",
+            "time": time.time(),
+            "id": req.req_id,
+            "reason": reason,
+            "queued": slot is None,
+        })
+        if slot is not None:
+            self._retire(slot, status="timed_out")
+        else:
+            self._finish(req, slot=None, status="timed_out")
 
     # ------------------------------------------------------------ loop
 
@@ -839,6 +952,14 @@ class ServingEngine:
         fixed-shape decode step over all slots and retire the finished.
         Returns the requests completed during this iteration."""
         done_before = len(self._completed)
+
+        # Deadline sweep BEFORE refill: an expired queue head must not
+        # be admitted, and an expired active slot's pages must be free
+        # for this step's refill/grow to use. Host-side only — the
+        # decode step below never sees a deadline, so the zero-retrace
+        # contract is untouched.
+        if self.guard is not None:
+            self.guard.expire(self)
 
         # refill — FCFS with head-of-line blocking: a new request only
         # admits when its prompt's pages are FREE. Never preempt to
@@ -909,6 +1030,16 @@ class ServingEngine:
             self._sample_root,
         )
         toks = np.asarray(toks)  # graftlint: disable=GL001 -- the scheduler NEEDS this sync: retire/refill decisions read the sampled tokens; one fetch per engine step, outside any jit
+        # NaN detection on the already-fetched tokens (zero extra
+        # transfers): poisoned logits sample out-of-vocab. Raised BEFORE
+        # any per-step bookkeeping mutates, so the host state a
+        # post-crash snapshot() captures is exactly the pre-step world —
+        # run_serve_with_recovery replays this step on a fresh engine.
+        bad = active & ((toks < 0) | (toks >= self.model.vocab_size))
+        if bad.any():
+            raise DecodeNanError(
+                step=self._step_count, slots=np.nonzero(bad)[0]
+            )
         self._step_count += 1
         n_active = int(active.sum())
         self._active_slot_steps += n_active
@@ -1023,6 +1154,8 @@ class ServingEngine:
                 "req_id": int(req.req_id),
                 "prompt": prompt,
                 "max_new_tokens": max_new,
+                "deadline_s": req.deadline_s,
+                "max_queue_s": req.max_queue_s,
                 "orig_prompt_len": int(req.orig_prompt_len),
                 "orig_max_new_tokens": int(req.orig_max_new_tokens),
                 "preemptions": int(req.preemptions),
@@ -1079,6 +1212,8 @@ class ServingEngine:
                 req_id=int(rec["req_id"]),
                 arrival_time=rec["arrival_time"],
             )
+            req.deadline_s = rec.get("deadline_s")
+            req.max_queue_s = rec.get("max_queue_s")
             req.orig_prompt_len = int(rec["orig_prompt_len"])
             req.orig_max_new_tokens = int(rec["orig_max_new_tokens"])
             req.preemptions = int(rec["preemptions"])
@@ -1119,6 +1254,8 @@ class ServingEngine:
             "pages_allocatable": self.cfg.num_pages - 1,
             "preemptions": self._preemptions,
             "recovered_requests": self._recovered,
+            "timed_out_requests": self._timed_out,
+            "shed_requests": self._shed,
             "page_churn": self.pool.total_allocs + self.pool.total_frees,
             "trash_rows_written": self._trash_rows,
         }

@@ -97,12 +97,28 @@ def _summarize(
     makespan: float,
     extra: dict[str, Any],
 ) -> dict[str, Any]:
+    # Terminal-status accounting (serve/guard.py): every request that
+    # leaves the system lands in exactly one bucket. Latency percentiles
+    # are computed over requests that actually DELIVERED (completed /
+    # recovered) — a rejected request has no latency, and a timed-out
+    # one's truncated stream would flatter the tail.
+    statuses = {"completed": 0, "rejected": 0, "timed_out": 0, "recovered": 0}
+    for r in reqs:
+        t = r.terminal_status
+        if t in statuses:
+            statuses[t] += 1
+    delivered = [
+        r
+        for r in reqs
+        if r.terminal_status in ("completed", "recovered")
+        and r.first_token_time is not None
+    ]
     ttfts = [
-        (r.first_token_time - r.arrival_time) * 1e3 for r in reqs
+        (r.first_token_time - r.arrival_time) * 1e3 for r in delivered
     ]
     per_tok = [
         (r.done_time - r.first_token_time) * 1e3 / max(1, r.output_tokens - 1)
-        for r in reqs
+        for r in delivered
     ]
     # Inter-token latency: gaps between consecutive SURFACED tokens of
     # one request (streaming delivery — engine._surface). Measured, not
@@ -112,7 +128,7 @@ def _summarize(
     # nothing (token_times stays empty), so its ITL reports 0 — TTFT is
     # its honest latency metric.
     itls: list[float] = []
-    for r in reqs:
+    for r in delivered:
         if len(r.token_times) > 1:
             diffs = np.diff(np.asarray(r.token_times))
             # A recovered request's token_times mix the dead process's
@@ -147,8 +163,44 @@ def _summarize(
         "tokens_per_sec": round(total_tokens / makespan, 2)
         if makespan > 0
         else 0.0,
+        **statuses,
         **extra,
     }
+
+
+def _emit_summary(sink: Any, record: dict[str, Any]) -> None:
+    """Emit a serve_summary plus its bench-shaped twins (metric + value)
+    so regress.py gates the serving envelope with its standard
+    arithmetic — including the absolute budgets
+    benchmarks/serve_smoke_budget.json arms. Shared by ``run_poisson``
+    and ``serve/guard.py::run_serve_with_recovery``."""
+    if sink is None:
+        return
+    sink.emit(record)
+    for metric, value, unit in (
+        ("serve_tokens_per_sec", record["tokens_per_sec"], "tokens/sec"),
+        ("serve_ttft_p99_ms", record["ttft_p99_ms"], "ms"),
+        ("serve_itl_p99_ms", record["itl_p99_ms"], "ms"),
+        # chaos visibility: requests replayed from a ServeSnapshot
+        # after a kill/resume (docs/reliability.md) — 0 on clean runs
+        (
+            "serve_recovered",
+            record.get("recovered_requests", 0),
+            "requests",
+        ),
+        # guard visibility (docs/reliability.md "Serving under failure
+        # and overload"): terminal sheds and deadline expiries — 0 on
+        # unguarded or under-capacity runs.
+        ("serve_rejected", record.get("rejected", 0), "requests"),
+        ("serve_timed_out", record.get("timed_out", 0), "requests"),
+    ):
+        sink.emit({
+            "kind": "bench",
+            "time": time.time(),
+            "metric": metric,
+            "value": value,
+            "unit": unit,
+        })
 
 
 def run_poisson(
@@ -172,9 +224,11 @@ def run_poisson(
     clock = engine.clock
     if warmup:
         buckets = sorted({engine._bucket_for(len(p)) for p in workload.prompts})
-        # no warmup records, no warmup spans
+        # no warmup records, no warmup spans, no warmup sheds (the
+        # guard's admission counters must only see measured traffic)
         saved_sink, engine.sink = engine.sink, None
         saved_tracer, engine.tracer = engine.tracer, None
+        saved_guard, engine.guard = engine.guard, None
         try:
             for b in buckets:
                 plen = min(b, engine.max_seq_len - 1)
@@ -187,9 +241,12 @@ def run_poisson(
         finally:
             engine.sink = saved_sink
             engine.tracer = saved_tracer
+            engine.guard = saved_guard
         # warmup requests must not count against the measurement
         engine._completed.clear()
         engine._preemptions = 0
+        engine._timed_out = 0
+        engine._shed = 0
         engine._step_count = 0
         engine._active_slot_steps = 0
         engine._trash_rows = 0
@@ -204,16 +261,17 @@ def run_poisson(
     t0 = clock()
     n = len(workload)
     i = 0
+    submitted: list[Request] = []
     while i < n or engine.busy:
         now = clock() - t0
         while i < n and workload.arrivals[i] <= now:
-            engine.submit(
+            submitted.append(engine.submit(
                 Request(
                     prompt=workload.prompts[i],
                     max_new_tokens=int(workload.max_new_tokens[i]),
                     arrival_time=t0 + float(workload.arrivals[i]),
                 )
-            )
+            ))
             i += 1
         if engine.busy:
             if watchdog is not None:
@@ -229,6 +287,17 @@ def run_poisson(
             )
     engine.finalize_trace()  # flush the final partial serve_window
     reqs = engine._completed[:]
+    # Terminal accounting (serve/guard.py): every submitted request must
+    # resolve to exactly one terminal status — a drained engine with an
+    # unresolved (or doubly-resolved) request is a scheduler bug, not a
+    # metrics footnote.
+    unresolved = [r.req_id for r in submitted if r.terminal_status is None]
+    assert not unresolved, f"requests ended unresolved: {unresolved}"
+    ids = [r.req_id for r in reqs]
+    assert len(ids) == len(set(ids)), (
+        f"requests resolved more than once: "
+        f"{sorted({x for x in ids if ids.count(x) > 1})}"
+    )
     makespan = max(r.done_time for r in reqs) - t0 if reqs else 0.0
     record = _summarize(
         "continuous",
@@ -242,30 +311,7 @@ def run_poisson(
             "kv_pool_tokens": engine.cfg.num_pages * engine.cfg.page_size,
         },
     )
-    if sink is not None:
-        sink.emit(record)
-        # bench-shaped twins (metric + value) so regress.py gates the
-        # serving envelope with its standard arithmetic — including the
-        # absolute budgets benchmarks/serve_smoke_budget.json arms.
-        for metric, value, unit in (
-            ("serve_tokens_per_sec", record["tokens_per_sec"], "tokens/sec"),
-            ("serve_ttft_p99_ms", record["ttft_p99_ms"], "ms"),
-            ("serve_itl_p99_ms", record["itl_p99_ms"], "ms"),
-            # chaos visibility: requests replayed from a ServeSnapshot
-            # after a kill/resume (docs/reliability.md) — 0 on clean runs
-            (
-                "serve_recovered",
-                record.get("recovered_requests", 0),
-                "requests",
-            ),
-        ):
-            sink.emit({
-                "kind": "bench",
-                "time": time.time(),
-                "metric": metric,
-                "value": value,
-                "unit": unit,
-            })
+    _emit_summary(sink, record)
     return record
 
 

@@ -20,6 +20,13 @@ and ``--metrics-dir`` (docs/serving.md):
     python -m cs744_pytorch_distributed_tutorial_tpu.serve_cli \
         --requests 24 --trace-dir /tmp/serve_trace --window-every 0.25
 
+    # graftguard: overload the engine 3x past sustainable, shed at the
+    # door, expire stale requests, and ride out injected decode faults
+    # under the supervised restart ladder (docs/reliability.md):
+    python -m cs744_pytorch_distributed_tutorial_tpu.serve_cli \
+        --requests 64 --rate 48 --deadline-s 30 --max-queue-depth 16 \
+        --shed-policy degrade --chaos 40:decode_nan,90:engine_crash
+
 Params are randomly initialized — serving latency/throughput and the
 parity contract are weight-independent, so the CLI does not train.
 """
@@ -102,7 +109,56 @@ def build_parser() -> argparse.ArgumentParser:
                         "p50/p99, queue depth, preemption rate, pool "
                         "counters); defaults to 0.25 when --trace-dir "
                         "is set")
+    # graftguard: deadlines + admission control (serve/guard.py);
+    # setting any of these attaches a ServeGuard to the engine
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="default end-to-end deadline per request; "
+                        "expiry retires it as timed_out and frees its "
+                        "pages")
+    p.add_argument("--max-queue-s", type=float, default=None,
+                   help="max time a request may wait for its FIRST "
+                        "token while queued")
+    p.add_argument("--max-queue-depth", type=int, default=None,
+                   help="bounded admission queue: arrivals beyond this "
+                        "depth are shed at the door")
+    p.add_argument("--shed-policy", default=None,
+                   choices=("reject", "degrade"),
+                   help="overload response: reject new arrivals, or "
+                        "degrade (trim max_new_tokens to the floor "
+                        "under page-pool pressure; outputs stay oracle "
+                        "prefixes)")
+    p.add_argument("--degrade-floor", type=int, default=8,
+                   help="min max_new_tokens a degrade trim leaves")
+    # chaos + supervised auto-recovery (utils/chaos.py, serve/guard.py)
+    p.add_argument("--chaos", default=None, metavar="IDX:KIND,...",
+                   help="inject serve faults at measured decode-step "
+                        "indices, e.g. '40:decode_nan,90:engine_crash'; "
+                        "kinds: decode_nan, slow_step, engine_crash. "
+                        "Implies the supervised recovery loop")
+    p.add_argument("--max-restarts", type=int, default=2,
+                   help="engine restarts before recovery gives up")
+    p.add_argument("--restart-backoff-s", type=float, default=0.0,
+                   help="base exponential-backoff delay between "
+                        "restarts")
+    p.add_argument("--step-timeout-s", type=float, default=None,
+                   help="watchdog deadline per decode step: a hung "
+                        "step escalates warn -> flight dump -> engine "
+                        "restart. Implies the supervised recovery loop")
     return p
+
+
+def _parse_chaos(spec: str) -> dict[int, str]:
+    """``"40:decode_nan,90:engine_crash"`` -> ``{40: ..., 90: ...}``."""
+    faults: dict[int, str] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        idx, sep, kind = part.partition(":")
+        if not sep:
+            raise ValueError(f"chaos spec {part!r} is not IDX:KIND")
+        faults[int(idx)] = kind
+    return faults
 
 
 def _make_sink(metrics_dir: str | None):
@@ -132,12 +188,15 @@ def main(argv: list[str] | None = None) -> None:
         TransformerLM,
     )
     from cs744_pytorch_distributed_tutorial_tpu.serve import (
+        GuardConfig,
         Request,
         ServeConfig,
+        ServeGuard,
         ServingEngine,
         make_poisson_workload,
         run_batch_baseline,
         run_poisson,
+        run_serve_with_recovery,
     )
 
     model = TransformerLM(
@@ -227,16 +286,78 @@ def main(argv: list[str] | None = None) -> None:
             tracer = ServeTracer(
                 args.num_slots, window_every_s=window_every
             )
-        engine = ServingEngine(model, params, cfg, sink=sink, tracer=tracer)
-        # Flight recorder over the serving loop: SIGTERM/uncaught-crash
-        # dumps the serve event ring tail + pool high-water through the
-        # sink — same discipline the training engines get.
-        flight = engine.make_flight_recorder()
-        flight.install()
-        try:
-            serve_rec = run_poisson(engine, workload, sink=sink)
-        finally:
-            flight.uninstall()
+        guard = None
+        if any(v is not None for v in (
+            args.deadline_s, args.max_queue_s,
+            args.max_queue_depth, args.shed_policy,
+        )):
+            guard = ServeGuard(cfg=GuardConfig(
+                deadline_s=args.deadline_s,
+                max_queue_s=args.max_queue_s,
+                max_queue_depth=args.max_queue_depth,
+                shed_policy=args.shed_policy or "reject",
+                degrade_floor=args.degrade_floor,
+            ))
+
+        if args.chaos or args.step_timeout_s is not None:
+            # Supervised recovery loop: the supervisor owns the flight
+            # recorder (one per engine generation, armed by its step
+            # watchdog) and restarts the engine from its snapshot on
+            # any ServeFailure.
+            from cs744_pytorch_distributed_tutorial_tpu.utils.chaos import (
+                SERVE_FAULT_KINDS,
+                FaultSchedule,
+                ServeChaosMonkey,
+            )
+
+            monkey = None
+            if args.chaos:
+                faults = _parse_chaos(args.chaos)
+                bad = sorted(
+                    set(faults.values()) - set(SERVE_FAULT_KINDS)
+                )
+                if bad:
+                    raise SystemExit(
+                        f"--chaos kinds {bad} not in {SERVE_FAULT_KINDS}"
+                    )
+                monkey = ServeChaosMonkey(
+                    FaultSchedule(faults), telemetry=sink
+                )
+
+            engines: list = []
+
+            def make_engine():
+                eng = ServingEngine(
+                    model, params, cfg,
+                    sink=sink, tracer=tracer, guard=guard,
+                )
+                engines.append(eng)
+                return eng
+
+            serve_rec = run_serve_with_recovery(
+                make_engine, workload,
+                monkey=monkey,
+                max_restarts=args.max_restarts,
+                backoff_s=args.restart_backoff_s,
+                step_timeout_s=args.step_timeout_s,
+                telemetry=sink,
+                sink=sink,
+            )
+            engine = engines[-1]
+        else:
+            engine = ServingEngine(
+                model, params, cfg, sink=sink, tracer=tracer, guard=guard,
+            )
+            # Flight recorder over the serving loop: SIGTERM/uncaught-
+            # crash dumps the serve event ring tail + pool high-water
+            # through the sink — same discipline the training engines
+            # get.
+            flight = engine.make_flight_recorder()
+            flight.install()
+            try:
+                serve_rec = run_poisson(engine, workload, sink=sink)
+            finally:
+                flight.uninstall()
 
         if args.trace_dir:
             import os

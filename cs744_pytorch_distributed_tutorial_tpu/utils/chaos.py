@@ -47,19 +47,37 @@ from __future__ import annotations
 import contextlib
 import os
 import signal
+import time
 from typing import Any
 
 import numpy as np
 
 from cs744_pytorch_distributed_tutorial_tpu.utils.failure import (
     DeviceLossError,
+    EngineCrashError,
     TrainingFailure,
     emit_event,
     run_with_recovery,
 )
 from cs744_pytorch_distributed_tutorial_tpu.utils.logging import get_logger
 
-FAULT_KINDS = ("nan", "device_loss", "sigterm", "process_kill")
+# Serve-side kinds target ``ServingEngine._decode_step`` (install via
+# ``ServeChaosMonkey``), keyed by cumulative DECODE-step index with the
+# same fire-once/spans-restarts semantics as the training kinds:
+# - ``"decode_nan"``  — the step runs, then its sampled tokens are
+#   poisoned out-of-vocab (NaN-logits analog); the engine's host-side
+#   token validation raises ``DecodeNanError``.
+# - ``"slow_step"``   — an injected stall before the step (wedged-chip
+#   analog); drives the serve watchdog's warn→dump→abort ladder.
+# - ``"engine_crash"`` — ``EngineCrashError`` raised BEFORE the step
+#   runs (XLA abort analog), so host state stays snapshot-consistent.
+SERVE_FAULT_KINDS = ("decode_nan", "slow_step", "engine_crash")
+FAULT_KINDS = (
+    "nan",
+    "device_loss",
+    "sigterm",
+    "process_kill",
+) + SERVE_FAULT_KINDS
 
 
 class SigtermFailure(TrainingFailure):
@@ -210,6 +228,67 @@ class ChaosMonkey:
 
         trainer.train_step = chaotic_step
         return trainer
+
+
+class ServeChaosMonkey(ChaosMonkey):
+    """Fire a ``FaultSchedule`` of serve kinds on a ``ServingEngine``.
+
+    Wraps ``engine._decode_step`` instead of ``trainer.train_step``; the
+    cumulative call counter is the DECODE-step index and — exactly like
+    the training monkey — is owned by the monkey, so re-``install`` on
+    the replacement engine after a restart and a popped fault can never
+    re-fire while the replayed steps count past it.
+
+    ``sleep`` is injectable so ``slow_step`` stalls are testable without
+    real wall time."""
+
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        telemetry: Any = None,
+        *,
+        first_call: int = 0,
+        sleep: Any = time.sleep,
+    ):
+        super().__init__(schedule, telemetry, first_call=first_call)
+        self.sleep = sleep
+
+    def install(self, engine: Any) -> Any:
+        """Monkeypatch ``engine._decode_step``. Returns the engine for
+        chaining inside ``run_serve_with_recovery``'s rebuild."""
+        orig = engine._decode_step
+
+        def chaotic_decode(*args, **kwargs):
+            idx = self.first_call + self.calls
+            self.calls += 1
+            fault = self.schedule.pop(idx)
+            kind = fault["kind"] if fault else None
+            if kind == "engine_crash":
+                self._inject(idx, kind)
+                # Before the step: no donated buffers consumed, no host
+                # bookkeeping advanced — snapshot() after the raise
+                # describes exactly the pre-step world.
+                raise EngineCrashError(step=idx)
+            if kind == "slow_step":
+                self._inject(idx, kind)
+                self.sleep(float(fault.get("stall_s", 0.5)))
+            pages, tok = orig(*args, **kwargs)
+            if kind == "decode_nan":
+                self._inject(idx, kind)
+                import jax.numpy as jnp
+
+                # NaN logits make every sample garbage; -1 is the
+                # canonical out-of-vocab poison the engine's host-side
+                # validation (DecodeNanError) is specified to catch.
+                tok = jnp.full_like(tok, -1)
+            return pages, tok
+
+        # Post-run tooling (obs/serve_trace.py::profile_serve_programs)
+        # unwraps to reach the jitted original's .lower()/AOT surface —
+        # and to keep profiling re-runs off the fault counter.
+        chaotic_decode.__wrapped__ = orig
+        engine._decode_step = chaotic_decode
+        return engine
 
 
 @contextlib.contextmanager

@@ -43,6 +43,7 @@ import json
 import sys
 import threading
 import time
+import traceback as _traceback
 from typing import Any, Callable
 
 import numpy as np
@@ -104,6 +105,59 @@ class ProcessLossError(TrainingFailure):
         )
         self.generation = generation
         self.dead = dead
+
+
+class ServeFailure(TrainingFailure):
+    """Base class for detected SERVING-engine failures.
+
+    The serving analog of ``TrainingFailure``: recoverable by rebuilding
+    the engine and resuming from ``ServingEngine.snapshot()`` (host-side
+    request state only — the snapshot taken AFTER the failing step is
+    consistent because the engine raises before any per-step request
+    bookkeeping). ``serve/guard.py::run_serve_with_recovery`` is the
+    ladder that catches these. Defined here (not in ``serve/``) so
+    ``utils/chaos.py`` can raise them without an import cycle."""
+
+
+class DecodeNanError(ServeFailure):
+    """A decode step produced out-of-vocabulary tokens — the logits were
+    NaN/inf-poisoned (real numerical blowup, or the chaos harness's
+    ``decode_nan`` fault). Detected host-side on the already-fetched
+    token array, so the check costs zero extra device transfers."""
+
+    def __init__(self, step: int, slots=()):
+        slots = tuple(int(s) for s in slots)
+        super().__init__(
+            f"decode step {step} produced out-of-vocab tokens"
+            + (f" in slots {list(slots)}" if slots else "")
+        )
+        self.step = step
+        self.slots = slots
+
+
+class EngineCrashError(ServeFailure):
+    """The decode step itself died (XLA abort, chaos ``engine_crash``).
+
+    Raised BEFORE the step runs, so the engine's host state still
+    describes the pre-step world and ``snapshot()`` is valid."""
+
+    def __init__(self, step: int):
+        super().__init__(f"engine crash at decode step {step}")
+        self.step = step
+
+
+class HungStepError(ServeFailure):
+    """A decode step outlived the watchdog's full escalation ladder
+    (warn → dump → abort). Raised by the SUPERVISOR after the step
+    finally returns (or is abandoned) — the hung thread itself cannot
+    raise."""
+
+    def __init__(self, elapsed_s: float):
+        super().__init__(
+            f"decode step hung for {elapsed_s:.1f}s (watchdog escalation "
+            f"exhausted)"
+        )
+        self.elapsed_s = elapsed_s
 
 
 class StepWatchdog:
@@ -460,6 +514,10 @@ def run_with_recovery(
                     "recovery_giveup",
                     restarts=restarts - 1,
                     failure=repr(e),
+                    # The full traceback string, not just repr(e): a
+                    # giveup is the record the operator debugs FROM, and
+                    # by then the process that could re-raise is gone.
+                    traceback="".join(_traceback.format_exception(e)),
                 )
                 log.critical(
                     "giving up after %d restarts (last failure: %s)", restarts - 1, e
