@@ -8,6 +8,12 @@ records, and prints a one-screen summary: steps covered, mean step time
 (first emission excluded — it amortizes compile), final/best loss, mean
 MFU where recorded, and total gradient bytes on the wire. Stdlib only —
 usable on any machine the JSONL lands on.
+
+Also accepts the graftfleet ``fleet_report.json`` artifact (a single
+pretty-printed object; its ``records`` list flattens into the stream)
+and summarizes its ``fleet_skew`` / ``fleet_incident`` /
+``fleet_summary`` rows: per-step collective-skew attribution with a
+straggler histogram, incident counts, and the run-level audit line.
 """
 
 from __future__ import annotations
@@ -19,17 +25,35 @@ from typing import Any
 
 
 def load_records(path: str) -> list[dict[str, Any]]:
-    records = []
     with open(path, encoding="utf-8") as f:
-        for i, line in enumerate(f):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except json.JSONDecodeError as e:
-                print(f"{path}:{i + 1}: skipping bad line ({e})",
-                      file=sys.stderr)
+        text = f.read()
+    # Whole-file JSON first: a pretty-printed object carrying "records"
+    # (the fleet_report.json artifact obs/fleet.py writes) flattens
+    # into its row list; a bare object/array is taken as-is. Anything
+    # that isn't one JSON document falls through to JSONL.
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict):
+        if isinstance(obj.get("records"), list):
+            return [r for r in obj["records"] if isinstance(r, dict)]
+        return [obj]
+    if isinstance(obj, list):
+        return [r for r in obj if isinstance(r, dict)]
+    records: list[dict[str, Any]] = []
+    for i, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as e:
+            print(f"{path}:{i + 1}: skipping bad line ({e})",
+                  file=sys.stderr)
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
     return records
 
 
@@ -189,6 +213,47 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         if r.get("kind") == "serve_phase_summary"
         and isinstance(r.get("decode_host_exposed_ms"), (int, float))
     ]
+    # graftfleet rows (obs/fleet.py fleet_report.json, flattened by
+    # load_records): skew attribution aggregated over post-warmup steps
+    # (straggler histogram + worst skew), incidents counted by event
+    # name, and the run-level summary (latest record wins).
+    fleet_skew_rows = [
+        r for r in records
+        if r.get("kind") == "fleet_skew" and not r.get("warmup")
+    ]
+    fleet_skew: dict[str, Any] | None = None
+    if fleet_skew_rows:
+        skews = [float(r["skew_ms"]) for r in fleet_skew_rows
+                 if isinstance(r.get("skew_ms"), (int, float))]
+        stragglers: dict[str, int] = {}
+        for r in fleet_skew_rows:
+            s = r.get("straggler")
+            if s is not None:
+                stragglers[f"r{s}"] = stragglers.get(f"r{s}", 0) + 1
+        fleet_skew = {
+            "steps": len(fleet_skew_rows),
+            "max_skew_ms": max(skews) if skews else None,
+            "mean_skew_ms": _mean(skews),
+            "stragglers": stragglers,
+        }
+    fleet_incidents: dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "fleet_incident" and isinstance(
+            r.get("event"), str
+        ):
+            fleet_incidents[r["event"]] = (
+                fleet_incidents.get(r["event"], 0) + 1
+            )
+    fleet_summaries = [r for r in records if r.get("kind") == "fleet_summary"]
+    fleet_summary = (
+        {
+            k: fleet_summaries[-1].get(k)
+            for k in ("generations", "ranks", "steps_attributed",
+                      "max_skew_ms", "problems", "torn_lines")
+        }
+        if fleet_summaries
+        else None
+    )
     # Chaos visibility (docs/reliability.md): per-request kind:"serve"
     # lifecycle events — preemption replays and kill/resume recoveries
     # (serve/engine.py emits one record per transition).
@@ -224,6 +289,9 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         ),
         "serve_preempt_replays": preempt_replays,
         "serve_recovered": recovered,
+        "fleet_skew": fleet_skew,
+        "fleet_incidents": fleet_incidents,
+        "fleet_summary": fleet_summary,
     }
 
 
@@ -327,6 +395,32 @@ def main(argv: list[str] | None = None) -> int:
             f"{summary['serve_preempt_replays']} preemption replays, "
             f"{summary['serve_recovered']} recovered requests",
         ))
+    fs = summary["fleet_summary"]
+    if fs:
+        rows.append((
+            "fleet",
+            f"generations {', '.join(f'g{g}' for g in fs['generations'] or [])}"
+            f", ranks {', '.join(f'r{r}' for r in fs['ranks'] or [])}, "
+            f"{_fmt(fs['steps_attributed'])} steps attributed, max skew "
+            f"{_fmt(fs['max_skew_ms'])} ms, {_fmt(fs['problems'])} audit "
+            f"problem(s), {_fmt(fs['torn_lines'])} torn line(s)",
+        ))
+    fsk = summary["fleet_skew"]
+    if fsk:
+        hist = ", ".join(
+            f"{k}={v}" for k, v in sorted(fsk["stragglers"].items())
+        )
+        rows.append((
+            "fleet skew",
+            f"{_fmt(fsk['steps'])} post-warmup steps, mean/max "
+            f"{_fmt(fsk['mean_skew_ms'])}/{_fmt(fsk['max_skew_ms'])} ms, "
+            f"stragglers {hist or '-'}",
+        ))
+    if summary["fleet_incidents"]:
+        by_event = ", ".join(
+            f"{k}={v}" for k, v in sorted(summary["fleet_incidents"].items())
+        )
+        rows.append(("fleet incidents", by_event))
     for wire, row in summary["sync_compare"].items():
         rows.append((
             f"overlap {wire}",

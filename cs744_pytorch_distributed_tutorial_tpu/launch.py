@@ -25,11 +25,18 @@ multihost-smoke CI job)::
 
 ``--kill STEP:RANK`` SIGKILLs the given GLOBAL rank at the given
 cumulative step (rank 0 = the coordinator — killing it exercises
-re-election). The demo worker checkpoints every step, so the resumed
-generation's loss trajectory is comparable (rtol 1e-6) against an
-uninterrupted run at the shrunk world size — the acceptance bar for
-the elastic path. Per-rank stdout lands in ``<store>/logs/``; the
-supervisor+worker event timeline in ``<store>/events.jsonl``.
+re-election). ``--slow RANK:MS`` stalls the given GLOBAL rank for MS
+milliseconds before every step — a seeded straggler whose late
+collective arrivals graftfleet's cross-rank skew attribution must pin.
+The demo worker checkpoints every step, so the resumed generation's
+loss trajectory is comparable (rtol 1e-6) against an uninterrupted run
+at the shrunk world size — the acceptance bar for the elastic path.
+Per-rank stdout lands in ``<store>/logs/``; the supervisor+worker
+event timeline in ``<store>/events.jsonl``; each rank stamps its
+step/collective boundaries into ``<store>/fleet/``, and the supervisor
+merges everything into ``<store>/fleet_trace.json`` (Perfetto) +
+``fleet_report.json`` at exit (``obs/fleet.py``; re-render or audit
+any time with ``python -m …obs fleet-report <store> --check``).
 """
 
 from __future__ import annotations
@@ -69,6 +76,14 @@ def _parse_kill(spec: str) -> tuple[int, int]:
         raise SystemExit(f"--kill expects STEP:RANK, got {spec!r}") from e
 
 
+def _parse_slow(spec: str) -> tuple[int, float]:
+    try:
+        rank_s, ms_s = spec.split(":")
+        return int(rank_s), float(ms_s)
+    except ValueError as e:
+        raise SystemExit(f"--slow expects RANK:MS, got {spec!r}") from e
+
+
 def _worker_train(args: argparse.Namespace) -> int:
     """The built-in demo worker: one elastic data-parallel tiny-CNN loop.
 
@@ -101,6 +116,10 @@ def _worker_train(args: argparse.Namespace) -> int:
 
     from cs744_pytorch_distributed_tutorial_tpu.config import TrainConfig
     from cs744_pytorch_distributed_tutorial_tpu.data import synthetic_cifar10
+    from cs744_pytorch_distributed_tutorial_tpu.obs.fleet import (
+        FleetStamper,
+        stamp_pair,
+    )
     from cs744_pytorch_distributed_tutorial_tpu.parallel import make_mesh
     from cs744_pytorch_distributed_tutorial_tpu.parallel.mesh import (
         shard_global_batch,
@@ -159,6 +178,23 @@ def _worker_train(args: argparse.Namespace) -> int:
             ctx.num_processes,
         )
 
+    # Arrival stamping (obs/fleet.py): wrap train_step so sync_enter is
+    # taken immediately before the step dispatches. Cross-process CPU
+    # collectives block at DISPATCH (the psum rendezvous is inside the
+    # train_step call, not behind the fetch), so this pre-dispatch
+    # instant is the rank's true arrival at the collective — any chaos
+    # stall installed OUTSIDE this wrapper delays it, and early ranks
+    # spend the gap blocked inside the step waiting. The monkeys below
+    # must wrap this, so install it first.
+    arrival: dict[str, tuple[float, float]] = {}
+    _unstamped_step = trainer.train_step
+
+    def _stamped_step(*step_args, **step_kwargs):
+        arrival["sync_enter"] = stamp_pair()
+        return _unstamped_step(*step_args, **step_kwargs)
+
+    trainer.train_step = _stamped_step
+
     if args.kill:
         kill_step, kill_rank = _parse_kill(args.kill)
         schedule = FaultSchedule(
@@ -173,22 +209,61 @@ def _worker_train(args: argparse.Namespace) -> int:
             rank=ctx.global_rank,
             first_call=start,
         ).install(trainer)
+    if args.slow:
+        slow_rank, slow_ms = _parse_slow(args.slow)
+        # A stall at EVERY step of the run: the schedule targets the
+        # global rank, so survivors re-parsing it keep the same
+        # straggler across generations. Installed after --kill's monkey
+        # (wrapping it), so the stall precedes the kill check.
+        ChaosMonkey(
+            FaultSchedule(
+                {
+                    s: {
+                        "kind": "slow_step",
+                        "rank": slow_rank,
+                        "stall_s": slow_ms / 1e3,
+                    }
+                    for s in range(args.steps)
+                }
+            ),
+            telemetry=_StoreTelemetry(store),
+            rank=ctx.global_rank,
+            first_call=start,
+        ).install(trainer)
 
     watchdog = CollectiveWatchdog(
         store, ctx, deadline_s=args.collective_deadline_s
+    )
+    # Per-rank fleet stamps (obs/fleet.py): step boundaries plus the
+    # sync window around the blocking fetch. Dispatch is async, so
+    # sync_enter is this rank's ARRIVAL at the collective — the stamp
+    # graftfleet aligns across ranks to name the straggler. The demo
+    # fetches every step anyway, so the stamps add no host syncs.
+    stamper = FleetStamper(
+        store.root, ctx.generation, ctx.global_rank, ctx.process_id
     )
     ds = synthetic_cifar10(args.global_batch, 8, seed=0)
     x, y = shard_global_batch(mesh, ds.train_images, ds.train_labels)
     key = jax.random.key(cfg.seed)
     for step in range(start, args.steps):
         watchdog.check()
+        step_enter = stamp_pair()
         with watchdog.watch():
             # Step + fetch + durable save are ONE watched section: all
             # three can block on a dead peer (the psum, the result
             # fetch behind it, Orbax's cross-process commit barrier).
             state, metrics = trainer.train_step(state, x, y, key)
             loss = float(jax.device_get(metrics["loss"]))
+            sync_exit = stamp_pair()
             ckpt.save(state, force=True, wait=True)
+        step_exit = stamp_pair()
+        stamper.stamp_step(
+            step,
+            step_enter=step_enter,
+            sync_enter=arrival.get("sync_enter", step_enter),
+            sync_exit=sync_exit,
+            step_exit=step_exit,
+        )
         hb.step = step
         print(
             f"[graftelastic] gen={ctx.generation} grank={ctx.global_rank} "
@@ -196,6 +271,7 @@ def _worker_train(args: argparse.Namespace) -> int:
             flush=True,
         )
     watchdog.close()
+    stamper.close()
     ckpt.close()
     hb.stop()
     return 0
@@ -241,6 +317,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kill", default=None, metavar="STEP:RANK",
                    help="demo worker: SIGKILL global rank RANK at "
                         "cumulative step STEP (0 = coordinator)")
+    p.add_argument("--slow", default=None, metavar="RANK:MS",
+                   help="demo worker: stall global rank RANK for MS "
+                        "milliseconds before every step (seeded "
+                        "straggler for fleet skew attribution)")
     p.add_argument("--collective-deadline-s", type=float, default=8.0,
                    help="demo worker: watchdog deadline for a step "
                         "blocked on a dead peer")
@@ -275,6 +355,8 @@ def main(argv: list[str] | None = None) -> int:
         ]
         if args.kill:
             cmd += ["--kill", args.kill]
+        if args.slow:
+            cmd += ["--slow", args.slow]
 
     env = None
     if args.platform == "cpu":
@@ -311,6 +393,22 @@ def main(argv: list[str] | None = None) -> int:
         len(run.generations),
         run.store.events_path,
     )
+    # Merge everything the run left behind into the fleet artifacts
+    # (Perfetto timeline + skew/incident report). Best-effort: a merge
+    # failure must never change the run's exit code.
+    try:
+        from cs744_pytorch_distributed_tutorial_tpu.obs.fleet import (
+            write_fleet_artifacts,
+        )
+
+        artifacts = write_fleet_artifacts(run.store.root)
+        log.info(
+            "graftfleet: merged timeline at %s (%d audit problem(s))",
+            artifacts["trace"],
+            len(artifacts["problems"]),
+        )
+    except Exception:
+        log.warning("graftfleet: artifact merge failed", exc_info=True)
     return 0 if run.success else 1
 
 

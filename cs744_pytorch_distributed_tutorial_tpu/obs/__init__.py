@@ -14,6 +14,16 @@ from .metrics import (
     tree_l2_norm,
     tree_sq_norm,
 )
+from .fleet import (
+    ClockAligner,
+    FleetStamper,
+    collective_skew,
+    fleet_check,
+    load_fleet_dir,
+    merge_timeline,
+    render_fleet_report,
+    write_fleet_artifacts,
+)
 from .flight import FlightRecorder, HbmHighWater, StragglerMonitor
 from .phases import (
     PhaseReport,
@@ -52,6 +62,14 @@ __all__ = [
     "speculative_accept_rate",
     "tree_l2_norm",
     "tree_sq_norm",
+    "ClockAligner",
+    "FleetStamper",
+    "collective_skew",
+    "fleet_check",
+    "load_fleet_dir",
+    "merge_timeline",
+    "render_fleet_report",
+    "write_fleet_artifacts",
     "FlightRecorder",
     "HbmHighWater",
     "StragglerMonitor",

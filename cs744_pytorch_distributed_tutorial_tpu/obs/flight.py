@@ -31,6 +31,7 @@ import signal
 import statistics
 import sys
 import threading
+import time
 from collections import deque
 from typing import Any, Callable
 
@@ -63,13 +64,17 @@ class StragglerMonitor:
         self.mad_k = float(mad_k)
         self.min_samples = int(min_samples)
         self.floor_s = float(floor_s)
-        self._ring: deque[tuple[int, float]] = deque(maxlen=window)
+        # (step, wall_s, t_wall, t_mono) — the record-time stamp pair
+        # makes dumped tails placeable on the merged fleet timeline.
+        self._ring: deque[tuple[int, float, float, float]] = deque(
+            maxlen=window
+        )
         self.outliers: deque[dict[str, Any]] = deque(maxlen=max_outliers)
         self.steps_recorded = 0
         self._max_s = 0.0
 
     def _median_mad(self) -> tuple[float, float]:
-        vals = [w for _, w in self._ring]
+        vals = [entry[1] for entry in self._ring]
         med = statistics.median(vals)
         mad = statistics.median(abs(v - med) for v in vals)
         return med, mad
@@ -92,9 +97,11 @@ class StragglerMonitor:
                     "median_s": med,
                     "mad_s": mad,
                     "excess_sigma": (wall_s - med) / sigma,
+                    "t_wall": time.time(),
+                    "t_mono": time.monotonic(),
                 }
                 self.outliers.append(out)
-        self._ring.append((int(step), wall_s))
+        self._ring.append((int(step), wall_s, time.time(), time.monotonic()))
         self.steps_recorded += 1
         self._max_s = max(self._max_s, wall_s)
         return out
@@ -114,8 +121,13 @@ class StragglerMonitor:
 
     def tail(self, n: int = 32) -> list[dict[str, Any]]:
         return [
-            {"step": step, "wall_s": wall_s}
-            for step, wall_s in list(self._ring)[-n:]
+            {
+                "step": step,
+                "wall_s": wall_s,
+                "t_wall": t_wall,
+                "t_mono": t_mono,
+            }
+            for step, wall_s, t_wall, t_mono in list(self._ring)[-n:]
         ]
 
 

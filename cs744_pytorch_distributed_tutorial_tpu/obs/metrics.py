@@ -249,7 +249,25 @@ class Telemetry:
             "run": self.run,
             "step": int(step),
             "time": time.time(),
+            # Wall+monotonic pair: obs/fleet.py anchors cross-process
+            # alignment on monotonic when available (immune to clock
+            # steps mid-run).
+            "mono": now,
         }
+        # generation/global-rank attribution so merged multi-process
+        # step streams stay per-rank attributable (same stamping as
+        # emit_event below).
+        try:
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.multihost import (
+                runtime_labels,
+            )
+
+            labels = runtime_labels()
+            record["process_id"] = labels["process_id"]
+            record["generation"] = labels["generation"]
+            record["global_rank"] = labels["global_rank"]
+        except Exception:  # stamping must never break telemetry
+            pass
         step_time = None
         if self._last_mono is not None and self._last_step is not None:
             dsteps = int(step) - self._last_step
@@ -285,6 +303,7 @@ class Telemetry:
             "run": self.run,
             "event": event,
             "time": time.time(),
+            "monotonic": time.monotonic(),
         }
         # process_id/generation attribution so merged multi-process
         # event streams stay per-rank attributable; explicit fields

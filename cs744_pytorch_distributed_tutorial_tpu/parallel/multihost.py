@@ -236,6 +236,12 @@ def attach(ctx: WorkerContext) -> "HeartbeatThread":
     hb = HeartbeatThread(store, ctx.generation, ctx.global_rank)
     hb.start()
     initialize(ctx.coordinator, ctx.num_processes, ctx.process_id)
+    # initialize() returns only after every rank reached the coordination
+    # service — the closest thing to a simultaneous instant the fleet
+    # has. Stamp (wall, monotonic) here so obs/fleet.py can align each
+    # rank's monotonic clock against a common reference.
+    with contextlib.suppress(OSError):
+        store.barrier_stamp(ctx.generation, ctx.global_rank)
     set_runtime_labels(
         process_id=ctx.process_id,
         process_count=ctx.num_processes,
@@ -263,13 +269,18 @@ class RendezvousStore:
         world_g000000.json   # one per generation: ranks, coordinator
         hb_g000000_r3.json   # per-(generation, global-rank) heartbeat
         dead_g000000.json    # accumulated death notes for a generation
+        sync_g000000_r3.json # rendezvous-barrier clock anchor per rank
         events.jsonl         # append-only kind:"event" stream
+        fleet/               # per-rank fleet_stamp streams (obs/fleet)
         logs/g000000_r3.log  # per-rank stdout+stderr (supervisor-owned)
 
     All writes are atomic (tmp + rename) except ``events.jsonl``, which
-    relies on O_APPEND single-``write`` atomicity — every writer appends
-    whole lines, so concurrent supervisor/worker events interleave but
-    never tear.
+    relies on O_APPEND single-``write`` atomicity — every writer builds
+    the full line first and hands it to the kernel in ONE ``os.write``
+    (retried only on the no-bytes-written edge), so concurrent
+    supervisor/worker events interleave but never tear. ``read_events``
+    still tolerates a torn tail (a writer crashing mid-record) and
+    reports it instead of silently dropping arbitrary interior lines.
     """
 
     def __init__(self, root: str):
@@ -311,15 +322,32 @@ class RendezvousStore:
     ) -> None:
         _atomic_write_json(
             self._hb_path(generation, global_rank),
-            {"rank": global_rank, "step": step, "time": time.time()},
+            {
+                "rank": global_rank,
+                "step": step,
+                "time": time.time(),
+                "monotonic": time.monotonic(),
+                "host": socket.gethostname(),
+            },
         )
 
     def heartbeat_age(
-        self, generation: int, global_rank: int, now: float | None = None
+        self,
+        generation: int,
+        global_rank: int,
+        now: float | None = None,
+        now_mono: float | None = None,
     ) -> float | None:
         """Seconds since the rank's newest beat in this generation; None
         if it has never beaten (still importing/attaching — the
-        supervisor's startup grace covers that window)."""
+        supervisor's startup grace covers that window).
+
+        When the beat carries a ``monotonic`` stamp from THIS host, the
+        age is the monotonic difference — immune to wall-clock steps
+        (NTP slews during a run would otherwise fake staleness or hide
+        it). Cross-host beats fall back to wall time: CLOCK_MONOTONIC is
+        per-boot and meaningless between machines. Passing ``now``
+        explicitly forces the wall path (tests pin time that way)."""
         try:
             with open(
                 self._hb_path(generation, global_rank), encoding="utf-8"
@@ -327,6 +355,14 @@ class RendezvousStore:
                 rec = json.load(f)
         except (FileNotFoundError, json.JSONDecodeError):
             return None
+        mono = rec.get("monotonic")
+        if (
+            now is None
+            and isinstance(mono, (int, float))
+            and rec.get("host") == socket.gethostname()
+        ):
+            now_mono = time.monotonic() if now_mono is None else now_mono
+            return now_mono - float(mono)
         beat = rec.get("time")
         if not isinstance(beat, (int, float)):
             return None
@@ -340,7 +376,12 @@ class RendezvousStore:
         merged = sorted(set(self.dead(generation)) | set(int(r) for r in ranks))
         _atomic_write_json(
             self._dead_path(generation),
-            {"generation": generation, "dead": merged, "time": time.time()},
+            {
+                "generation": generation,
+                "dead": merged,
+                "time": time.time(),
+                "monotonic": time.monotonic(),
+            },
         )
 
     def dead(self, generation: int) -> set[int]:
@@ -350,44 +391,115 @@ class RendezvousStore:
         except (FileNotFoundError, json.JSONDecodeError):
             return set()
 
+    # -- rendezvous-barrier clock anchors
+    def _sync_path(self, generation: int, global_rank: int) -> str:
+        return os.path.join(
+            self.root, f"sync_g{generation:06d}_r{global_rank}.json"
+        )
+
+    def barrier_stamp(self, generation: int, global_rank: int) -> None:
+        """Record this rank's (wall, monotonic) the moment the
+        generation's rendezvous barrier released — ``attach()`` calls it
+        right after ``mesh.initialize`` returns, which every rank leaves
+        near-simultaneously. ``obs/fleet.py`` uses these anchors to map
+        each rank's monotonic clock onto one shared timeline."""
+        _atomic_write_json(
+            self._sync_path(generation, global_rank),
+            {
+                "generation": generation,
+                "global_rank": global_rank,
+                "wall": time.time(),
+                "mono": time.monotonic(),
+                "host": socket.gethostname(),
+            },
+        )
+
+    def read_barrier_stamps(
+        self, generation: int
+    ) -> dict[int, dict[str, Any]]:
+        prefix = f"sync_g{generation:06d}_r"
+        out: dict[int, dict[str, Any]] = {}
+        for name in os.listdir(self.root):
+            if not (name.startswith(prefix) and name.endswith(".json")):
+                continue
+            try:
+                with open(
+                    os.path.join(self.root, name), encoding="utf-8"
+                ) as f:
+                    rec = json.load(f)
+                out[int(name[len(prefix):-len(".json")])] = rec
+            except (OSError, ValueError, json.JSONDecodeError):
+                continue
+        return out
+
     # -- events + logs
     def append_event(self, event: str, **fields: Any) -> None:
         """One ``kind:"event"`` line, stamped with the runtime labels
         (same schema as ``utils/failure.py::emit_event``). O_APPEND with
-        a single write keeps concurrent writers line-atomic."""
+        ONE full-line ``os.write`` keeps concurrent writers line-atomic;
+        the loop only re-enters when the kernel accepted zero bytes
+        (EINTR-style edge) — a partial count would mean an interleaving
+        hazard, so it raises instead of retrying the remainder."""
         record = {
             "kind": "event",
             "event": event,
             "time": time.time(),
+            "monotonic": time.monotonic(),
             **runtime_labels(),
             **fields,
         }
-        line = json.dumps(record, default=str) + "\n"
+        data = (json.dumps(record, default=str) + "\n").encode("utf-8")
         fd = os.open(
             self.events_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644
         )
         try:
-            os.write(fd, line.encode("utf-8"))
+            while True:
+                written = os.write(fd, data)
+                if written == len(data):
+                    return
+                if written == 0:
+                    continue
+                raise OSError(
+                    f"torn event append: {written}/{len(data)} bytes"
+                )
         finally:
             os.close(fd)
 
     def events(self) -> list[dict[str, Any]]:
-        out: list[dict[str, Any]] = []
-        try:
-            with open(self.events_path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        with contextlib.suppress(json.JSONDecodeError):
-                            out.append(json.loads(line))
-        except FileNotFoundError:
-            pass
-        return out
+        records, _ = read_events(self.events_path)
+        return records
+
+    def events_with_torn(self) -> tuple[list[dict[str, Any]], int]:
+        return read_events(self.events_path)
 
     def log_path(self, generation: int, global_rank: int) -> str:
         return os.path.join(
             self.root, "logs", f"g{generation:06d}_r{global_rank}.log"
         )
+
+
+def read_events(path: str) -> tuple[list[dict[str, Any]], int]:
+    """Torn-tolerant JSONL reader for event streams: parse every intact
+    line, count the ones that don't parse instead of silently dropping
+    them. A single unparsable FINAL line is the expected signature of a
+    writer that died mid-record; unparsable interior lines indicate real
+    interleaving corruption — both are surfaced through the torn count
+    so ``obs fleet-report`` can say so."""
+    records: list[dict[str, Any]] = []
+    torn = 0
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    torn += 1
+    except FileNotFoundError:
+        pass
+    return records, torn
 
 
 class HeartbeatThread(threading.Thread):
@@ -508,11 +620,11 @@ class CollectiveWatchdog:
         least once, then went silent past ``stale_after_s``)."""
         gen = self.ctx.generation
         dead = set(self.store.dead(gen))
-        now = time.time()
+        now_mono = time.monotonic()
         for r in self._peers():
             if r in dead:
                 continue
-            age = self.store.heartbeat_age(gen, r, now=now)
+            age = self.store.heartbeat_age(gen, r, now_mono=now_mono)
             if age is not None and age > self.stale_after_s:
                 dead.add(r)
         return sorted(dead)

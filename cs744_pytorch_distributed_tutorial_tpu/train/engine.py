@@ -1143,10 +1143,18 @@ class Trainer:
                         or metrics_due
                         or pending_ckpt is not None
                     ):
+                        # (wall, mono) pair bracketing the gated fetch:
+                        # obs/fleet.py aligns these across ranks for
+                        # collective-skew attribution — the stamps ride
+                        # a fetch that was already due, no new sync.
+                        sync_enter_wall = time.time()
+                        sync_enter_mono = time.monotonic()
                         # graftlint: disable=GL001 -- cadence-gated: only
                         # reached when a log/metrics/ckpt boundary is due and
                         # the device work is already fenced.
                         loss = float(metrics["loss"])
+                        sync_exit_wall = time.time()
+                        sync_exit_mono = time.monotonic()
                         if watchdog is not None:
                             watchdog.disarm()  # the fetch is the hang point
                         if cfg.halt_on_nonfinite and not math.isfinite(loss):
@@ -1173,6 +1181,10 @@ class Trainer:
                                 batch=batch_idx,
                                 lr=lr_at(steps_done),
                                 grad_sync_bytes=wire_bytes,
+                                sync_enter_wall=sync_enter_wall,
+                                sync_enter_mono=sync_enter_mono,
+                                sync_exit_wall=sync_exit_wall,
+                                sync_exit_mono=sync_exit_mono,
                                 **obs_fields,
                             )
                         if pending_ckpt is not None and steps_done == pending_ckpt[0]:

@@ -1477,7 +1477,14 @@ class LMTrainer:
                         params, opt_state, m = self.train_step(
                             params, opt_state, x, y, step
                         )
+                        # (wall, mono) bracketing the blocking fetch:
+                        # obs/fleet.py aligns these across ranks for
+                        # collective-skew attribution.
+                        sync_enter_wall = time.time()
+                        sync_enter_mono = time.monotonic()
                         loss = float(m["loss"])
+                        sync_exit_wall = time.time()
+                        sync_exit_mono = time.monotonic()
                 finally:
                     if arm_now:
                         watchdog.disarm()
@@ -1522,6 +1529,10 @@ class LMTrainer:
                         loss=loss,
                         lr=lr_at(step),
                         grad_sync_bytes=wire_bytes,
+                        sync_enter_wall=sync_enter_wall,
+                        sync_enter_mono=sync_enter_mono,
+                        sync_exit_wall=sync_exit_wall,
+                        sync_exit_mono=sync_exit_mono,
                         **step_fields,
                     )
                 ckpt_due = bool(

@@ -29,6 +29,10 @@ at deterministic points in a run:
   schedule can never re-fire it — the dead rank is absent from the new
   generation.
 
+- ``"slow_step"`` (shared with the serve schedule) — a targeted stall
+  before the step on one global rank: the seeded straggler whose late
+  collective arrival ``obs/fleet.py`` attributes cross-rank.
+
 Faults live in a ``FaultSchedule`` keyed by *cumulative* train-step call
 index — the counter spans restarts, so a schedule "fault at call 3"
 fires once even though recovery replays calls 0..2. Schedules are
@@ -155,12 +159,19 @@ class ChaosMonkey:
     automatically). ``injected`` records ``(call_index, kind)`` for
     assertions.
 
-    ``rank`` is this process's GLOBAL rank for ``process_kill``
-    targeting (faults aimed at another rank are skipped silently);
-    ``first_call`` offsets the cumulative index for a process that
-    resumed mid-run — a re-exec'd survivor starting at step K passes
-    ``first_call=K`` so the schedule keys keep meaning absolute step
-    indices across generations."""
+    ``rank`` is this process's GLOBAL rank for ``process_kill`` /
+    ``slow_step`` targeting (faults aimed at another rank are skipped
+    silently); ``first_call`` offsets the cumulative index for a
+    process that resumed mid-run — a re-exec'd survivor starting at
+    step K passes ``first_call=K`` so the schedule keys keep meaning
+    absolute step indices across generations.
+
+    ``slow_step`` in a TRAINING schedule is the seeded-straggler fault:
+    ``{"kind": "slow_step", "rank": 3, "stall_s": 0.25}`` stalls only
+    the targeted rank before its step, so every peer arrives at the
+    collective early and waits — the asymmetry ``obs/fleet.py``'s
+    cross-rank skew attribution exists to name. ``sleep`` is injectable
+    so stalls are testable without wall time."""
 
     def __init__(
         self,
@@ -169,11 +180,13 @@ class ChaosMonkey:
         *,
         rank: int | None = None,
         first_call: int = 0,
+        sleep: Any = time.sleep,
     ):
         self.schedule = schedule
         self.telemetry = telemetry
         self.rank = rank
         self.first_call = int(first_call)
+        self.sleep = sleep
         self.calls = 0  # cumulative train_step invocations, all restarts
         self.injected: list[tuple[int, str]] = []
         self._log = get_logger()
@@ -206,6 +219,18 @@ class ChaosMonkey:
                 # target is already dead): not our fault to fire. The
                 # step proceeds and the collective watchdog reports
                 # what the peer's SIGKILL did to it.
+                kind = None
+            if kind == "slow_step":
+                target = fault.get("rank")
+                if (
+                    target is None
+                    or self.rank is None
+                    or int(target) == self.rank
+                ):
+                    self._inject(idx, kind)
+                    self.sleep(float(fault.get("stall_s", 0.5)))
+                # The step itself proceeds normally — the fault is the
+                # stall, and only on the targeted rank.
                 kind = None
             if kind == "device_loss":
                 self._inject(idx, kind)
