@@ -158,16 +158,20 @@ def sync_compare(
     """Bytes-on-wire mode: samples/sec/chip AND analytic gradient payload
     bytes sent per device per step, one JSON line per sync setting —
     f32 per-leaf ('auto', the DDP analog), f32 bucketed flat allreduce,
-    and the int8-quantized bucket allreduce with error feedback. The
-    bucketed rows also carry their OVERLAPPED throughput
-    (``--sync-overlap``, parallel/overlap.py), and each overlapped wire
+    the int8-quantized bucket allreduce with error feedback, and the
+    zero1 reduce-scatter schedule (parallel/zero.py). The bucketed rows
+    also carry their OVERLAPPED throughput (``--sync-overlap``,
+    parallel/overlap.py / parallel/zero.py), and each overlapped wire
     gets one ``kind="sync_compare"`` record comparing fused vs
     overlapped step wall and the sync_exposed_ms each leaves on the
-    table (graftscope's attribution, obs/phases.py)."""
+    table (graftscope's attribution, obs/phases.py) — so
+    metrics_summary.py renders an ``overlap <wire>`` row per sharded
+    strategy alongside the pure-DP ones."""
     rows = (
         ("f32_per_leaf_auto", "auto", "none", None),
         ("f32_bucketed_allreduce", "allreduce", "none", "bucket"),
         ("int8_bucketed_allreduce", "allreduce", "int8", "bucket+int8"),
+        ("f32_zero1_scatter", "zero1", "none", "bucket"),
     )
     for label, sync, compress, ov in rows:
         sps, wire = _bench_at(batch, steps, sync=sync, grad_compress=compress)

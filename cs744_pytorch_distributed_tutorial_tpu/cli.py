@@ -43,12 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 = per-leaf collectives (default 4)")
     p.add_argument("--sync-overlap", choices=["off", "bucket", "bucket+int8"],
                    default=None,
-                   help="overlapped gradient sync (parallel/overlap.py): "
-                        "reverse-layer-order buckets dispatch each "
-                        "collective as backward produces its gradients, "
-                        "with the SGD update applied per bucket; 'bucket' "
-                        "overlaps the float wire (allreduce/ring), "
-                        "'bucket+int8' the int8+EF wire")
+                   help="overlapped gradient sync (parallel/overlap.py, "
+                        "parallel/zero.py): reverse-layer-order buckets "
+                        "dispatch each collective as backward produces its "
+                        "gradients, with the optimizer applied per bucket; "
+                        "'bucket' overlaps the float wire (allreduce/ring/"
+                        "zero1/fsdp), 'bucket+int8' the int8+EF wire "
+                        "(allreduce/ring/zero1)")
     p.add_argument("--model", default=None, help="model name (default vgg11)")
     p.add_argument("--image-size", type=int, default=None,
                    help="square input resolution (default 32; >64 selects "

@@ -109,14 +109,19 @@ class TrainConfig:
     # megabytes instead of one per parameter leaf (DDP's bucketing
     # reducer). 0 disables bucketing (per-leaf collectives).
     sync_bucket_mb: float = 4.0
-    # Overlapped gradient sync (parallel/overlap.py): reverse-layer-order
-    # buckets whose collectives dispatch as backward produces each
-    # bucket's gradients, with the SGD update applied per bucket as its
-    # sync completes — DDP's reducer schedule as dataflow. "bucket"
-    # overlaps the float wire (sync in {allreduce, ring});
-    # "bucket+int8" overlaps the int8+EF compressed wire. Requires the
-    # reference's fixed-LR SGD recipe (optimizer="sgd", constant lr, no
-    # warmup/clip), accum_steps=1, and no zero1/fsdp/fused_optimizer.
+    # Overlapped gradient sync (parallel/overlap.py, parallel/zero.py):
+    # reverse-layer-order buckets whose collectives dispatch as backward
+    # produces each bucket's gradients, with the optimizer applied per
+    # bucket as its sync completes — DDP's reducer schedule as dataflow.
+    # "bucket" overlaps the float wire: sync in {allreduce, ring} runs
+    # per-bucket mean + torch-SGD apply, sync in {zero1, fsdp} runs the
+    # per-bucket psum_scatter -> per-shard apply -> all_gather schedule
+    # inside the sharded optimizer. "bucket+int8" overlaps the int8+EF
+    # compressed wire (allreduce/ring, or zero1 where the quantization
+    # chunks live on bucket boundaries; fsdp has no separate grad wire
+    # to quantize). accum_steps>1 composes: only the final micro-step's
+    # sync overlaps. Requires the fixed-LR SGD recipe (this engine's
+    # sharded strategies already do) and no fused_optimizer.
     sync_overlap: str = "off"  # "off" | "bucket" | "bucket+int8"
 
     # Numerics: params/BN stats stay float32; compute dtype is the MXU knob.

@@ -140,11 +140,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "coalesced buffers")
     p.add_argument("--sync-overlap", choices=["off", "bucket", "bucket+int8"],
                    default="off",
-                   help="overlapped gradient sync (parallel/overlap.py): "
-                        "reverse-layer-order buckets, per-bucket collective "
-                        "+ per-bucket SGD apply (pure-DP layouts, "
-                        "--optimizer sgd with constant lr); 'bucket+int8' "
-                        "overlaps the int8+EF wire (--grad-compress int8)")
+                   help="overlapped gradient sync (parallel/overlap.py, "
+                        "parallel/zero.py): reverse-layer-order buckets, "
+                        "per-bucket collective + per-bucket optimizer "
+                        "apply. Pure DP needs --optimizer sgd with "
+                        "constant lr; --zero1/--fsdp admit any registry "
+                        "optimizer and schedule (per-bucket scatter -> "
+                        "chunk apply -> gather). 'bucket+int8' overlaps "
+                        "the int8+EF wire (--grad-compress int8; pure DP "
+                        "or --zero1)")
     p.add_argument("--label-smoothing", type=float, default=0.0)
     p.add_argument("--dropout-rate", type=float, default=0.0,
                    help="residual dropout on each block's sublayer "

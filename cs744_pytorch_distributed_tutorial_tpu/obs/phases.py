@@ -29,12 +29,19 @@ the missing instrument:
 
 Restrictions (raise ``ValueError``, not wrong answers): segmentation
 needs a separable explicit sync pass, so ``accum_steps == 1``, no
-zero1/fsdp (their sync is fused into the sharded update), no
-fused_optimizer; the LM engine additionally requires a pure
-data-parallel layout (seq/tensor collectives live inside the forward
-and cannot be carved out). ``'auto'``/``'none'`` reroute through the
-numerically-identical explicit allreduce, exactly as the engine itself
-does under legacy shard_map.
+fsdp (its gradient reduction is the AD transpose of the parameter
+all_gather, inserted inside backward), no fused_optimizer. zero1 IS
+segmentable (fused or overlapped): the grad-sync segment runs the
+per-bucket ``psum_scatter`` (or the int8+EF quantized wire) and the
+optimizer segment runs the chunk updates PLUS the per-bucket delta
+all_gathers — the gather is deliberately counted as optimizer time,
+so ``sync_exposed_ms`` reports the unhidden scatter wire, the part
+backward can hide. The LM engine additionally requires a pure
+data-parallel, unsharded-optimizer layout (seq/tensor collectives
+live inside the forward and cannot be carved out).
+``'auto'``/``'none'`` reroute through the numerically-identical
+explicit allreduce, exactly as the engine itself does under legacy
+shard_map.
 
 Segments compile with ``check_vma=False``: without the replication
 analysis there are no AD-inserted collectives, so differentiating the
@@ -483,6 +490,7 @@ class CifarSegments:
 
     def __init__(self, trainer: Any):
         import jax
+        import jax.numpy as jnp
         from jax import lax
         from jax.sharding import PartitionSpec as P
 
@@ -518,16 +526,28 @@ class CifarSegments:
                 "accumulation the sync runs inside the microbatch scan and "
                 "cannot be carved into its own program"
             )
-        if trainer._zero1 or trainer._fsdp or cfg.fused_optimizer:
+        if trainer._fsdp or cfg.fused_optimizer:
             raise ValueError(
                 f"graftscope segmentation does not support sync={cfg.sync!r}/"
-                f"fused_optimizer={cfg.fused_optimizer}: the grad sync is "
-                "fused into the sharded/fused update and has no separable "
-                "sync phase to time"
+                f"fused_optimizer={cfg.fused_optimizer}: fsdp's gradient "
+                "reduction is the AD transpose of its parameter all_gather "
+                "(inserted inside backward) and the fused kernel is one "
+                "whole-tree Pallas call — neither has a separable sync "
+                "phase. allreduce/ring/zero1 (fused or overlapped) are "
+                "segmentable"
+            )
+        if trainer._zero1 and not (
+            trainer._bucket_bytes and trainer.axis_size > 1
+        ):
+            raise ValueError(
+                "graftscope zero1 segmentation requires the bucketed "
+                "multi-device path (sync_bucket_mb > 0, num_devices > 1): "
+                "the per-leaf fallback has no bucket lanes to carve"
             )
         self.trainer = trainer
         self.compress = trainer._compress
         self.overlap = getattr(trainer, "_overlap", False)
+        self.zero1 = trainer._zero1
         axis_size = trainer.axis_size
         model, tx = trainer.model, trainer.tx
         bucket_bytes = trainer._bucket_bytes
@@ -705,7 +725,135 @@ class CifarSegments:
                 ef=ef_stacked,
             )
 
-        if self.overlap:
+        # ZeRO-1 segments: the sharded optimizer's step carved at the
+        # scatter boundary — KEEP IN SYNC with parallel/zero.py
+        # Zero1SGD._apply_bucketed (same bucket layout, same chunk rule,
+        # same lane names). seg_sync_zero1 runs each bucket's
+        # psum_scatter (or the int8+EF quantized wire) and returns the
+        # device-owned mean-gradient rows; seg_opt_zero1 runs the chunk
+        # updates AND the per-bucket delta all_gathers. The gather is
+        # deliberately counted as optimizer time: the scatter wire is
+        # what the overlapped schedule hides under backward, so
+        # sync_exposed_ms reports the UNHIDDEN scatter.
+        def zero1_layout(tree):
+            return _B.bucket_layout(
+                tree, bucket_bytes, rows=axis_size, reverse=self.overlap
+            )
+
+        def seg_sync_zero1(grads_stacked):
+            g = jax.tree.map(lambda a: a[0], grads_stacked)
+            layout = zero1_layout(g)
+            bufs = _B.flatten_for_sync(g, layout)
+            rows = []
+            for k, buf in enumerate(bufs):
+                with jax.named_scope(
+                    f"graftscope/sync/overlap_rs/zero1/bucket{k:02d}"
+                ):
+                    rows.append(
+                        (
+                            lax.psum_scatter(
+                                buf, DATA_AXIS, scatter_dimension=0
+                            )
+                            / axis_size
+                        )[None]
+                    )
+            return tuple(rows)
+
+        def seg_sync_zero1_compressed(grads_stacked, ef_stacked):
+            from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (
+                _int8_allreduce_flat,
+            )
+
+            g = jax.tree.map(lambda a: a[0], grads_stacked)
+            e = jax.tree.map(lambda a: a[0], ef_stacked)
+            layout = zero1_layout(g)
+            g_bufs = _B.flatten_for_sync(g, layout)
+            e_bufs = _B.flatten_for_sync(e, layout)
+            idx = lax.axis_index(DATA_AXIS)
+            rows, new_e = [], []
+            for k, (gbuf, ebuf) in enumerate(zip(g_bufs, e_bufs)):
+                cols = gbuf.shape[-1]
+                with jax.named_scope(
+                    f"graftscope/sync/overlap_rs/zero1/bucket{k:02d}"
+                ):
+                    b = gbuf.reshape(-1).astype(jnp.float32) + ebuf.reshape(
+                        -1
+                    ).astype(jnp.float32)
+                    mean, resid = _int8_allreduce_flat(
+                        b, DATA_AXIS, axis_size
+                    )
+                new_e.append(resid.reshape(axis_size, cols))
+                rows.append(
+                    lax.dynamic_index_in_dim(
+                        mean.reshape(axis_size, cols).astype(gbuf.dtype),
+                        idx,
+                        0,
+                        keepdims=True,
+                    )
+                )
+            ef_out = _B.unflatten(new_e, layout)
+            return tuple(rows), jax.tree.map(lambda a: a[None], ef_out)
+
+        def seg_opt_zero1(state, scattered, stats_stacked, ef_stacked):
+            idx = lax.axis_index(DATA_AXIS)
+            leaves_p, treedef = jax.tree.flatten(state.params)
+            leaves_m = jax.tree.leaves(state.opt_state)
+            layout = zero1_layout(state.params)
+            by_bucket = [[] for _ in layout.bucket_cols]
+            for i, slot in enumerate(layout.slots):
+                by_bucket[slot.bucket].append((slot.offset, i, slot))
+            new_p = [None] * len(leaves_p)
+            new_m = [None] * len(leaves_p)
+            for k, group in enumerate(by_bucket):
+                group.sort(key=lambda t: t[0])
+                g_mine = scattered[k][0]
+                deltas = []
+                with jax.named_scope(
+                    f"graftscope/optimizer/overlap/bucket{k:02d}"
+                ):
+                    for off, i, slot in group:
+                        chunk = slot.size
+                        p = leaves_p[i]
+                        pad = axis_size * chunk - p.size
+                        p2d = jnp.pad(p.ravel(), (0, pad)).reshape(
+                            axis_size, chunk
+                        )
+                        p_mine = lax.dynamic_index_in_dim(
+                            p2d, idx, 0, keepdims=False
+                        )
+                        m_new, delta_mine = tx._sgd_chunk_update(
+                            p_mine,
+                            leaves_m[i].reshape(chunk),
+                            g_mine[off : off + chunk],
+                        )
+                        deltas.append(delta_mine)
+                        new_m[i] = m_new.reshape(1, chunk)
+                with jax.named_scope(
+                    f"graftscope/sync/overlap_ag/zero1/bucket{k:02d}"
+                ):
+                    delta_buf = lax.all_gather(
+                        jnp.concatenate(deltas), DATA_AXIS, axis=0
+                    )
+                for off, i, slot in group:
+                    chunk = slot.size
+                    p = leaves_p[i]
+                    delta_flat = delta_buf[:, off : off + chunk].reshape(
+                        axis_size * chunk
+                    )[: p.size]
+                    new_p[i] = p + delta_flat.reshape(p.shape)
+            return TrainState(
+                step=state.step + 1,
+                params=jax.tree.unflatten(treedef, new_p),
+                batch_stats=stats_stacked,
+                opt_state=jax.tree.unflatten(treedef, new_m),
+                ef=ef_stacked,
+            )
+
+        if self.zero1:
+            seg_sync = seg_sync_zero1
+            seg_sync_compressed = seg_sync_zero1_compressed
+            seg_opt = seg_opt_zero1
+        elif self.overlap:
             seg_sync = seg_sync_overlap
             seg_sync_compressed = seg_sync_overlap_compressed
             seg_opt = seg_opt_overlap
@@ -724,17 +872,22 @@ class CifarSegments:
         batch_in = (state_specs, P(DATA_AXIS), P(DATA_AXIS), P())
         self.forward = sm(seg_forward, batch_in, P())
         self.grads = sm(seg_grads, batch_in, (P(), P(DATA_AXIS), P(DATA_AXIS)))
+        # zero1's sync segment yields device-OWNED rows (one [1, cols]
+        # shard per bucket), not a replicated mean tree — spec them
+        # sharded over data; the prefix P(DATA_AXIS) covers the whole
+        # per-bucket tuple.
+        synced_spec = P(DATA_AXIS) if self.zero1 else P()
         if self.compress:
             self.sync = sm(
                 seg_sync_compressed,
                 (P(DATA_AXIS), P(DATA_AXIS)),
-                (P(), P(DATA_AXIS)),
+                (synced_spec, P(DATA_AXIS)),
             )
         else:
-            self.sync = sm(seg_sync, (P(DATA_AXIS),), P())
+            self.sync = sm(seg_sync, (P(DATA_AXIS),), synced_spec)
         self.opt = sm(
             seg_opt,
-            (state_specs, P(), P(DATA_AXIS), state_specs.ef),
+            (state_specs, synced_spec, P(DATA_AXIS), state_specs.ef),
             state_specs,
         )
         # Non-donating fused step over the SAME mapped function the
@@ -794,8 +947,14 @@ class LMSegments:
             )
         if trainer._zero1_opt is not None or cfg.fsdp:
             raise ValueError(
-                "graftscope segmentation does not support zero1/fsdp: the "
-                "DP reduction is fused into the sharded update"
+                "graftscope LM segmentation does not support zero1/fsdp: "
+                "the DP reduction is fused into the sharded update (and "
+                "for fsdp it is the AD transpose of the parameter "
+                "all_gather). Time those schedules with the CIFAR engine's "
+                "zero1 segments, or from a profile_dir trace — the "
+                "overlapped schedule labels per-bucket lanes "
+                "(graftscope/sync/overlap_rs/*, graftscope/optimizer/"
+                "overlap/*, graftscope/sync/overlap_ag/*)"
             )
         if (
             trainer.seq_size > 1

@@ -247,6 +247,13 @@ def sync_bytes_per_step(
       When ``bucket_bytes`` is given (and ``params`` is a tree), the
       element count is the EXACT padded count the wire kernels move
       (``_int8_padded_elems``); otherwise the unpadded approximation.
+    - ``zero1_int8``: zero1's gradient reduction rides the quantized
+      allreduce — each ``[axis_size, cols]`` chunk bucket flattens to
+      ``n * cols`` elements, pads to the kernel's ``n * m * Q`` form,
+      and moves at the int8 ring factor — while the float parameter
+      deltas still all_gather at (n-1)/n of the (padded) buffer bytes.
+      Exact when ``bucket_bytes`` is given; unpadded approximation
+      otherwise.
     - ``none`` (or a 1-sized axis): 0.
     """
     if isinstance(params, int):
@@ -269,4 +276,18 @@ def sync_bytes_per_step(
             )
         payload = elems * (1.0 + 4.0 / quant_chunk)
         return int(ring_factor * payload)
+    if strategy == "zero1_int8":
+        if bucket_bytes:
+            layout = bucket_layout(params, bucket_bytes, rows=n, reverse=reverse)
+            padded = 0  # the int8 kernel's n*m*Q padded flat count
+            gathered = 0  # float delta elements per bucket (n * cols)
+            for cols in layout.bucket_cols:
+                flat = n * cols
+                m = -(-flat // (n * quant_chunk))
+                padded += n * m * quant_chunk
+                gathered += flat
+        else:
+            padded = gathered = elems
+        wire = ring_factor * padded * (1.0 + 4.0 / quant_chunk)
+        return int(wire + (n - 1) / n * 4.0 * gathered)
     raise ValueError(f"unknown sync strategy {strategy!r}")

@@ -137,8 +137,9 @@ def split_momentum(opt_state):
     reconstitutes an opt_state with the SAME pytree structure (so jit
     donation and checkpoints see no layout change). Raises for any state
     that is not the plain ``add_decayed_weights -> trace -> scale`` chain
-    the overlap gating admits (a schedule would add a count we do not
-    advance here).
+    the pure-DP overlap gating admits (a schedule would add a count we
+    do not advance here), naming the ``--sync-overlap`` route that DOES
+    support the configuration instead.
     """
     if isinstance(opt_state, optax.TraceState):
         return opt_state.trace, lambda t: optax.TraceState(trace=t)
@@ -154,9 +155,18 @@ def split_momentum(opt_state):
 
                 return s.trace, rebuild
     raise ValueError(
-        "sync_overlap requires the fixed-LR SGD chain "
+        "this overlapped path applies the bucketed torch-SGD rule "
+        "directly, so it needs the fixed-LR SGD chain "
         "(add_decayed_weights -> trace -> scale); opt_state "
-        f"{type(opt_state).__name__} has no optax.TraceState to split"
+        f"{type(opt_state).__name__} has no optax.TraceState to split. "
+        "--sync-overlap support matrix: pure-DP allreduce/ring take "
+        "'bucket' (float) or 'bucket+int8' (quantized+EF wire) with SGD "
+        "+ constant LR only; --sync zero1/fsdp overlap through the "
+        "sharded optimizers instead (parallel/zero.py), which admit "
+        "any registry optimizer (sgd/adamw/lion) and LR schedules — "
+        "use 'bucket' there, or 'bucket+int8' with zero1 for the "
+        "quantized wire. Schedules, tensor/seq sharding and "
+        "grad-clipping stay fused-only."
     )
 
 

@@ -404,7 +404,12 @@ def sync_wire_bytes(
     follows the reversed bucket partition. The telemetry layer records
     this number as ``grad_sync_bytes`` per step.
     """
-    if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
+    if name == "zero1" and grad_compress == "int8":
+        # zero1's int8+EF wire flattens the rows=axis_size chunk
+        # buckets through the quantized allreduce and still pays the
+        # float delta all_gather — its own accounting branch.
+        strategy = "zero1_int8"
+    elif grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
         strategy = "int8_ring" if name in ("ring", "int8_ring") else "int8_allreduce"
     else:
         strategy = name
@@ -442,16 +447,21 @@ def sync_units(
     leaves = len(jax.tree.leaves(params))
     if axis_size <= 1 or name == "none":
         return leaves
+    # zero1/fsdp resolve FIRST: their units follow the rows=axis_size
+    # chunk layout even when the int8 wire rides on top (zero1's
+    # quantized allreduce flattens the same [axis_size, cols] buckets).
+    if name in ("zero1", "fsdp"):
+        if bucket_bytes:
+            layout = B.bucket_layout(
+                params, bucket_bytes, rows=axis_size, reverse=overlap
+            )
+            return len(layout.bucket_cols)
+        return leaves
     if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
         layout = B.bucket_layout(
             params, bucket_bytes or B.DEFAULT_BUCKET_BYTES, rows=0, reverse=overlap
         )
         return len(layout.bucket_cols)
-    if name in ("zero1", "fsdp"):
-        if bucket_bytes:
-            layout = B.bucket_layout(params, bucket_bytes, rows=axis_size)
-            return len(layout.bucket_cols)
-        return leaves
     if (bucket_bytes or overlap) and name in _BUCKETED:
         rows = axis_size if name == "ring" else 0
         layout = B.bucket_layout(
@@ -490,7 +500,9 @@ def expected_collective_schedule(
       scales travel separately in each phase);
     - ``int8_ring``: 4(n-1) ppermutes (codes + scales per hop, both
       phases);
-    - ``zero1``/``fsdp``: delegated to ``parallel.zero``'s own contract;
+    - ``zero1``/``fsdp``: delegated to ``parallel.zero``'s own contract
+      (with ``grad_compress="int8"``, zero1's int8+EF wire contract —
+      2 all_to_alls + 3 all_gathers per unit, no reduce_scatter);
     - ``none`` (or 1-sized axis): no collectives.
 
     Returns None for unknown names (no contract to assert).
@@ -498,12 +510,15 @@ def expected_collective_schedule(
     from cs744_pytorch_distributed_tutorial_tpu.parallel.zero import (
         fsdp_collective_schedule,
         zero1_collective_schedule,
+        zero1_int8_collective_schedule,
     )
 
     n = int(axis_size)
     u = int(units) * int(syncs_per_step)
     if name == "none" or n <= 1:
         return {}
+    if name == "zero1" and grad_compress == "int8":
+        return zero1_int8_collective_schedule(u, n)
     if grad_compress == "int8" or name in ("int8_allreduce", "int8_ring"):
         if name in ("ring", "int8_ring"):
             return {"ppermute": 4 * (n - 1) * u}

@@ -204,6 +204,66 @@ def _gather_flat(shards, shape_tree, axis_name: str):
     return jax.tree.map(leaf, shards, shape_tree)
 
 
+def _gather_bucketed_flat(
+    shards,
+    shape_tree,
+    axis_name: str,
+    axis_size: int,
+    bucket_bytes: int,
+    *,
+    reverse: bool = False,
+):
+    """Bucketed FSDP unshard: local ``[1, chunk]`` shards concatenate
+    into one flat buffer per bucket (in slot-OFFSET order — a reverse
+    layout assigns in-bucket offsets in reversed leaf order), one
+    ``all_gather`` per bucket materializes ``[axis_size, cols]``, and
+    leaves slice back out. Differentiating through this unshard still
+    delivers reduce-scattered gradients — the AD transpose of the
+    bucketed all_gather is ONE ``psum_scatter`` per bucket, with the
+    concatenation transposing to the per-leaf split. ``reverse`` selects
+    the overlapped schedule's reverse-order layout: the transposed
+    psum_scatters then land bucket-by-bucket in backward order, each one
+    issuable the moment its bucket's gradients exist."""
+    import contextlib
+
+    from cs744_pytorch_distributed_tutorial_tpu.parallel import buckets as B
+
+    s = axis_size
+    layout = B.bucket_layout(shape_tree, bucket_bytes, rows=s, reverse=reverse)
+    leaves_sh = jax.tree.leaves(shards)
+    parts: list[list] = [[] for _ in layout.bucket_cols]
+    for sh, slot in zip(leaves_sh, layout.slots):
+        parts[slot.bucket].append((slot.offset, sh.reshape(-1)))
+    gathered = []
+    for k, ps in enumerate(parts):
+        ctx = (
+            jax.named_scope(f"graftscope/sync/overlap_ag/fsdp/bucket{k:02d}")
+            if reverse
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            gathered.append(
+                lax.all_gather(
+                    jnp.concatenate(
+                        [f for _, f in sorted(ps, key=lambda t: t[0])]
+                    ),
+                    axis_name,
+                    axis=0,
+                )
+            )  # [s, cols] per bucket
+    leaves_shape, treedef = jax.tree.flatten(shape_tree)
+    out = []
+    for sds, slot in zip(leaves_shape, layout.slots):
+        chunk = slot.size
+        full = gathered[slot.bucket][:, slot.offset : slot.offset + chunk]
+        out.append(
+            full.reshape(-1)[: math.prod(sds.shape)]
+            .reshape(sds.shape)
+            .astype(sds.dtype)
+        )
+    return jax.tree.unflatten(treedef, out)
+
+
 def zero1_collective_schedule(units: int, axis_size: int) -> dict[str, int]:
     """Gradient-collective contract of one ZeRO-1 step: one psum_scatter
     (primitive ``reduce_scatter``) delivering each device's chunk of the
@@ -220,6 +280,20 @@ def fsdp_collective_schedule(units: int, axis_size: int) -> dict[str, int]:
     whose AD transpose is one reduce_scatter of the gradients — the same
     pair count as ZeRO-1, issued on the other side of the matmuls."""
     return zero1_collective_schedule(units, axis_size)
+
+
+def zero1_int8_collective_schedule(
+    units: int, axis_size: int
+) -> dict[str, int]:
+    """ZeRO-1 with the int8+EF wire (``sync_overlap='bucket+int8'``):
+    per bucket the quantized allreduce replaces the float psum_scatter —
+    2 all_to_alls + 2 all_gathers (codes and scales travel separately in
+    each phase, ``parallel/sync._int8_allreduce_flat``) — and the float
+    parameter-delta all_gather still restores replicated params, so
+    3 all_gathers total per unit and no reduce_scatter anywhere."""
+    if axis_size <= 1:
+        return {}
+    return {"all_to_all": 2 * units, "all_gather": 3 * units}
 
 
 class Zero1SGD:
@@ -239,6 +313,7 @@ class Zero1SGD:
         axis_name: str,
         axis_size: int,
         bucket_bytes: int | None = None,
+        overlap: bool = False,
     ):
         self.learning_rate = learning_rate
         self.momentum = momentum
@@ -256,6 +331,17 @@ class Zero1SGD:
         self.bucket_bytes = (
             DEFAULT_BUCKET_BYTES if bucket_bytes is None else int(bucket_bytes)
         )
+        # ``overlap`` selects the overlapped schedule's REVERSE
+        # tree-flatten-order bucket layout (parallel/overlap.py): the
+        # last-computed gradients sync first, so each bucket's
+        # psum_scatter -> chunk update -> all_gather chain can run under
+        # the remaining backward (XLA's latency-hiding scheduler sees no
+        # cross-bucket dependency — the weight-update-sharding dataflow
+        # of arxiv 2004.13336). Bucket ASSIGNMENT is the only change:
+        # every collective stays column-elementwise on the same per-leaf
+        # [axis_size, chunk] blocks, so the float path is bitwise equal
+        # to the fused (reverse=False) schedule.
+        self.overlap = bool(overlap)
 
     def _chunk(self, size: int) -> int:
         return -(-size // self.axis_size)  # ceil
@@ -275,16 +361,29 @@ class Zero1SGD:
         m_new = self.momentum * m_mine + g_eff
         return m_new, -self.learning_rate * m_new
 
-    def apply(self, params, momenta, grads):
+    def apply(self, params, momenta, grads, ef=None):
         """One ZeRO-1 step on local LOCAL grads (pre-sync): returns
         (replicated new params, local momentum shards). With
         ``bucket_bytes`` set (the default) the per-leaf psum_scatter /
         all_gather pair collapses to one pair per BUCKET: leaves'
         ``[axis_size, chunk]`` blocks concatenate along columns (same row
         placement, so each element's reduction is unchanged) and the
-        parameter deltas gather back as one flat buffer per bucket."""
+        parameter deltas gather back as one flat buffer per bucket.
+
+        ``ef`` (an error-feedback residual tree shaped like ``grads``)
+        swaps each bucket's float psum_scatter for the int8+EF quantized
+        allreduce (``parallel/sync._int8_allreduce_flat``) on that
+        bucket's wire payload — residuals stay per-bucket because the
+        quantization chunks never cross bucket boundaries — and a THIRD
+        return value carries the new residual tree."""
         if self.bucket_bytes and self.axis_size > 1:
-            return self._apply_bucketed(params, momenta, grads)
+            return self._apply_bucketed(params, momenta, grads, ef)
+        if ef is not None:
+            raise ValueError(
+                "the int8 wire for zero1 requires the bucketed path "
+                "(bucket_bytes > 0 and axis_size > 1): quantization "
+                "chunks are defined on bucket boundaries"
+            )
         s = self.axis_size
 
         def leaf(p, m, g):
@@ -311,48 +410,114 @@ class Zero1SGD:
         new_momenta = jax.tree.map(lambda _, o: o[1], params, out)
         return new_params, new_momenta
 
-    def _apply_bucketed(self, params, momenta, grads):
+    def _apply_bucketed(self, params, momenta, grads, ef=None):
+        """Per-bucket scatter -> chunk update -> delta gather with NO
+        value flowing between buckets: bucket k's all_gather depends
+        only on its own psum_scatter and chunk updates, so the XLA
+        scheduler may run bucket k+1's collective under bucket k's
+        compute (and, with ``overlap``, under the remaining backward).
+        In-bucket work walks slots in OFFSET order — a reverse layout
+        assigns in-bucket offsets in reversed leaf order."""
+        import contextlib
+
         from cs744_pytorch_distributed_tutorial_tpu.parallel import buckets as B
+
+        def scope(name):
+            # Overlap lanes for graftscope/Perfetto; pure HLO metadata
+            # (zero jaxpr eqns), only labeled on the overlapped schedule.
+            if self.overlap:
+                return jax.named_scope(name)
+            return contextlib.nullcontext()
 
         s = self.axis_size
         idx = lax.axis_index(self.axis_name)
-        layout = B.bucket_layout(grads, self.bucket_bytes, rows=s)
-        # [s, cols] buffers; one reduce-scatter per bucket delivers this
-        # device's row of the gradient SUM, divided into the mean.
-        g_mine_bufs = [
-            lax.psum_scatter(buf, self.axis_name, scatter_dimension=0) / s
-            for buf in B.flatten_for_sync(grads, layout)
-        ]
+        layout = B.bucket_layout(
+            grads, self.bucket_bytes, rows=s, reverse=self.overlap
+        )
+        g_bufs = B.flatten_for_sync(grads, layout)
+        ef_bufs = B.flatten_for_sync(ef, layout) if ef is not None else None
         leaves_p, treedef = jax.tree.flatten(params)
         leaves_m = jax.tree.leaves(momenta)
-        delta_parts: list[list] = [[] for _ in g_mine_bufs]
-        new_m_leaves = []
-        for p, m, slot in zip(leaves_p, leaves_m, layout.slots):
-            chunk = slot.size
-            g_mine = g_mine_bufs[slot.bucket][slot.offset : slot.offset + chunk]
-            pad = s * chunk - p.size
-            p2d = jnp.pad(p.ravel(), (0, pad)).reshape(s, chunk)
-            p_mine = lax.dynamic_index_in_dim(p2d, idx, 0, keepdims=False)
-            m_new, delta_mine = self._sgd_chunk_update(
-                p_mine, m.reshape(chunk), g_mine
-            )
-            delta_parts[slot.bucket].append(delta_mine)
-            new_m_leaves.append(m_new.reshape(1, chunk))
-        # One all_gather per bucket restores every device's deltas.
-        delta_bufs = [
-            lax.all_gather(jnp.concatenate(ps), self.axis_name, axis=0)
-            for ps in delta_parts
-        ]
-        new_p_leaves = []
-        for p, slot in zip(leaves_p, layout.slots):
-            chunk = slot.size
-            delta = delta_bufs[slot.bucket][:, slot.offset : slot.offset + chunk]
-            delta_flat = delta.reshape(s * chunk)[: p.size]
-            new_p_leaves.append(p + delta_flat.reshape(p.shape))
-        return (
+        by_bucket: list[list] = [[] for _ in layout.bucket_cols]
+        for i, slot in enumerate(layout.slots):
+            by_bucket[slot.bucket].append((slot.offset, i, slot))
+        new_p_leaves: list = [None] * len(leaves_p)
+        new_m_leaves: list = [None] * len(leaves_p)
+        new_ef_bufs: list = []
+        for k, group in enumerate(by_bucket):
+            group.sort(key=lambda t: t[0])
+            cols = g_bufs[k].shape[-1]
+            with scope(f"graftscope/sync/overlap_rs/zero1/bucket{k:02d}"):
+                if ef_bufs is None:
+                    # One reduce-scatter delivers this device's row of
+                    # the gradient SUM, divided into the mean.
+                    g_mine = (
+                        lax.psum_scatter(
+                            g_bufs[k], self.axis_name, scatter_dimension=0
+                        )
+                        / s
+                    )
+                else:
+                    # int8+EF wire: quantized allreduce of this bucket's
+                    # grads + carried residual, then slice our row of
+                    # the mean (every device reduces one shard, so the
+                    # full mean is materialized — the schedule trades
+                    # the reduce_scatter for 2 all_to_alls + 2
+                    # all_gathers of ~1/4 the bytes).
+                    from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (  # noqa: E501
+                        _int8_allreduce_flat,
+                    )
+
+                    b = g_bufs[k].reshape(-1).astype(jnp.float32) + ef_bufs[
+                        k
+                    ].reshape(-1).astype(jnp.float32)
+                    mean, resid = _int8_allreduce_flat(
+                        b, self.axis_name, s
+                    )
+                    new_ef_bufs.append(resid.reshape(s, cols))
+                    g_mine = lax.dynamic_index_in_dim(
+                        mean.reshape(s, cols).astype(g_bufs[k].dtype),
+                        idx,
+                        0,
+                        keepdims=False,
+                    )
+            deltas = []
+            with scope(f"graftscope/optimizer/overlap/bucket{k:02d}"):
+                for off, i, slot in group:
+                    chunk = slot.size
+                    p = leaves_p[i]
+                    pad = s * chunk - p.size
+                    p2d = jnp.pad(p.ravel(), (0, pad)).reshape(s, chunk)
+                    p_mine = lax.dynamic_index_in_dim(
+                        p2d, idx, 0, keepdims=False
+                    )
+                    m_new, delta_mine = self._sgd_chunk_update(
+                        p_mine,
+                        leaves_m[i].reshape(chunk),
+                        g_mine[off : off + chunk],
+                    )
+                    deltas.append(delta_mine)
+                    new_m_leaves[i] = m_new.reshape(1, chunk)
+            # One all_gather restores every device's deltas for this
+            # bucket the moment its chunk updates finish.
+            with scope(f"graftscope/sync/overlap_ag/zero1/bucket{k:02d}"):
+                delta_buf = lax.all_gather(
+                    jnp.concatenate(deltas), self.axis_name, axis=0
+                )
+            for off, i, slot in group:
+                chunk = slot.size
+                p = leaves_p[i]
+                delta_flat = delta_buf[:, off : off + chunk].reshape(
+                    s * chunk
+                )[: p.size]
+                new_p_leaves[i] = p + delta_flat.reshape(p.shape)
+        out = (
             jax.tree.unflatten(treedef, new_p_leaves),
             jax.tree.unflatten(treedef, new_m_leaves),
         )
+        if ef is None:
+            return out
+        return (*out, B.unflatten(new_ef_bufs, layout))
 
 
 class FsdpSGD(Zero1SGD):
@@ -395,32 +560,19 @@ class FsdpSGD(Zero1SGD):
         and leaves slice back out. Differentiating through this unshard
         still delivers reduce-scattered gradients — the AD transpose of
         the bucketed all_gather is ONE ``psum_scatter`` per bucket, with
-        the concatenation transposing to the per-leaf split."""
+        the concatenation transposing to the per-leaf split. With
+        ``overlap`` the layout reverses (see ``_gather_bucketed_flat``)
+        so the transposed reduce-scatters overlap the backward."""
         if not (self.bucket_bytes and self.axis_size > 1):
             return _gather_flat(shards, shape_tree, self.axis_name)
-        from cs744_pytorch_distributed_tutorial_tpu.parallel import buckets as B
-
-        s = self.axis_size
-        layout = B.bucket_layout(shape_tree, self.bucket_bytes, rows=s)
-        leaves_sh = jax.tree.leaves(shards)
-        parts: list[list] = [[] for _ in layout.bucket_cols]
-        for sh, slot in zip(leaves_sh, layout.slots):
-            parts[slot.bucket].append(sh.reshape(-1))
-        gathered = [
-            lax.all_gather(jnp.concatenate(ps), self.axis_name, axis=0)
-            for ps in parts
-        ]  # [s, cols] per bucket
-        leaves_shape, treedef = jax.tree.flatten(shape_tree)
-        out = []
-        for sds, slot in zip(leaves_shape, layout.slots):
-            chunk = slot.size
-            full = gathered[slot.bucket][:, slot.offset : slot.offset + chunk]
-            out.append(
-                full.reshape(-1)[: math.prod(sds.shape)]
-                .reshape(sds.shape)
-                .astype(sds.dtype)
-            )
-        return jax.tree.unflatten(treedef, out)
+        return _gather_bucketed_flat(
+            shards,
+            shape_tree,
+            self.axis_name,
+            self.axis_size,
+            self.bucket_bytes,
+            reverse=self.overlap,
+        )
 
     def apply(self, param_shards, momenta, grad_chunks):
         """One FSDP step from CHUNKED grad sums (the ``[1, chunk]``
@@ -504,6 +656,8 @@ class Zero1Adam:
         seq_size: int = 1,
         shard_axes: dict | None = None,
         clip_norm: float | None = None,
+        bucket_bytes: int | None = None,
+        overlap: bool = False,
     ):
         self.schedule = schedule
         self.b1, self.b2, self.eps = b1, b2, eps
@@ -518,6 +672,28 @@ class Zero1Adam:
         if clip_norm is not None and clip_norm <= 0:
             raise ValueError(f"clip_norm must be > 0, got {clip_norm}")
         self.clip_norm = clip_norm
+        # Overlapped reduce-scatter schedule (parallel/overlap.py):
+        # reverse-order buckets, per-bucket scatter -> chunk rule ->
+        # delta gather with the step scalars hoisted once. Pure-DP only:
+        # seq replicas, model shard axes and global-norm clipping all
+        # need cross-chunk joins that would reintroduce the barrier.
+        from cs744_pytorch_distributed_tutorial_tpu.parallel.buckets import (
+            DEFAULT_BUCKET_BYTES,
+        )
+
+        self.bucket_bytes = (
+            DEFAULT_BUCKET_BYTES if bucket_bytes is None else int(bucket_bytes)
+        )
+        self.overlap = bool(overlap)
+        if self.overlap and (
+            self.shard_axes or (seq_size > 1) or clip_norm is not None
+        ):
+            raise ValueError(
+                "sync_overlap with a sharded optimizer admits pure data "
+                "parallelism only: seq/tensor/expert sharding and "
+                "grad_clip_norm need cross-chunk joins that defeat the "
+                "per-bucket schedule"
+            )
 
     #: Sharded moment collections this rule carries (subclasses with
     #: single-moment rules — lion, sgd — override; the elastic-resume
@@ -680,11 +856,24 @@ class Zero1Adam:
             lambda t: lax.select(trigger, t, t * scale), chunks
         )
 
-    def apply(self, params, state, grads, specs=None):
+    def apply(self, params, state, grads, specs=None, ef=None):
         """One ZeRO-1 step from LOCAL (pre-sync) grads: returns
         (replicated new params, new state with local moment shards).
         ``specs`` is the param PartitionSpec tree (tensor-sharded leaves
-        chunk their LOCAL shard; omit for all-replicated)."""
+        chunk their LOCAL shard; omit for all-replicated). With
+        ``overlap`` set the step routes through the per-bucket
+        reverse-order schedule (``_apply_overlapped``); ``ef`` (an
+        error-feedback tree shaped like ``grads``) additionally selects
+        the int8 wire there and adds a third return value — the new
+        residual tree."""
+        if self.overlap and self.axis_size > 1:
+            return self._apply_overlapped(params, state, grads, ef=ef)
+        if ef is not None:
+            raise ValueError(
+                "the int8 wire for a sharded optimizer requires "
+                "sync_overlap='bucket+int8' (the overlapped per-bucket "
+                "schedule owns the quantization boundaries)"
+            )
         s = self.axis_size
         count, lr, c1, c2 = self._step_scalars(state)
         if specs is None:
@@ -730,6 +919,111 @@ class Zero1Adam:
         for i, name in enumerate(self.MOMENTS):
             new_state[name] = pick(1 + i)
         return pick(0), new_state
+
+    def _apply_overlapped(self, params, state, grads, ef=None):
+        """Reverse-order per-bucket schedule for the LM chunk rules
+        (arxiv 2004.13336's weight-update sharding as dataflow): per
+        bucket, one psum_scatter of the gradient slice (or the int8+EF
+        quantized allreduce when ``ef`` is given), the chunk rule on
+        this device's owned chunk the moment that scatter lands — with
+        ``_step_scalars`` hoisted ONCE per step, not per bucket — and
+        one all_gather of the parameter deltas. No value flows between
+        buckets, so the collectives overlap the remaining backward.
+        Float numerics are bitwise-equal to the fused per-leaf ``apply``
+        path: every collective stays column-elementwise on the same
+        per-leaf ``[axis_size, chunk]`` blocks."""
+        from cs744_pytorch_distributed_tutorial_tpu.parallel import buckets as B
+
+        s = self.axis_size
+        idx = lax.axis_index(self.axis_name)
+        count, lr, c1, c2 = self._step_scalars(state)
+        g32 = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        layout = B.bucket_layout(g32, self.bucket_bytes, rows=s, reverse=True)
+        g_bufs = B.flatten_for_sync(g32, layout)
+        ef_bufs = B.flatten_for_sync(ef, layout) if ef is not None else None
+        leaves_p, treedef = jax.tree.flatten(params)
+        mom_leaves = [jax.tree.leaves(state[n]) for n in self.MOMENTS]
+        by_bucket: list[list] = [[] for _ in layout.bucket_cols]
+        for i, slot in enumerate(layout.slots):
+            by_bucket[slot.bucket].append((slot.offset, i, slot))
+        new_p_leaves: list = [None] * len(leaves_p)
+        new_mom_leaves: list[list] = [
+            [None] * len(leaves_p) for _ in self.MOMENTS
+        ]
+        new_ef_bufs: list = []
+        for k, group in enumerate(by_bucket):
+            group.sort(key=lambda t: t[0])
+            cols = g_bufs[k].shape[-1]
+            with jax.named_scope(
+                f"graftscope/sync/overlap_rs/zero1/bucket{k:02d}"
+            ):
+                if ef_bufs is None:
+                    g_mine = (
+                        lax.psum_scatter(
+                            g_bufs[k], self.axis_name, scatter_dimension=0
+                        )
+                        / s
+                    )
+                else:
+                    from cs744_pytorch_distributed_tutorial_tpu.parallel.sync import (  # noqa: E501
+                        _int8_allreduce_flat,
+                    )
+
+                    b = g_bufs[k].reshape(-1) + ef_bufs[k].reshape(
+                        -1
+                    ).astype(jnp.float32)
+                    mean, resid = _int8_allreduce_flat(b, self.axis_name, s)
+                    new_ef_bufs.append(resid.reshape(s, cols))
+                    g_mine = lax.dynamic_index_in_dim(
+                        mean.reshape(s, cols), idx, 0, keepdims=False
+                    )
+            deltas = []
+            with jax.named_scope(
+                f"graftscope/optimizer/overlap/bucket{k:02d}"
+            ):
+                for off, i, slot in group:
+                    chunk = slot.size
+                    p = leaves_p[i]
+                    pad = s * chunk - p.size
+                    p2d = jnp.pad(
+                        p.ravel().astype(jnp.float32), (0, pad)
+                    ).reshape(s, chunk)
+                    p_mine = lax.dynamic_index_in_dim(
+                        p2d, idx, 0, keepdims=False
+                    )
+                    new_moms, update = self._chunk_rule(
+                        p_mine,
+                        [m[i].reshape(chunk) for m in mom_leaves],
+                        g_mine[off : off + chunk],
+                        c1,
+                        c2,
+                    )
+                    deltas.append(-lr * update)
+                    for j, nm in enumerate(new_moms):
+                        new_mom_leaves[j][i] = nm.reshape(
+                            mom_leaves[j][i].shape
+                        )
+            with jax.named_scope(
+                f"graftscope/sync/overlap_ag/zero1/bucket{k:02d}"
+            ):
+                delta_buf = lax.all_gather(
+                    jnp.concatenate(deltas), self.axis_name, axis=0
+                )
+            for off, i, slot in group:
+                chunk = slot.size
+                p = leaves_p[i]
+                new_p = (
+                    p.ravel().astype(jnp.float32)
+                    + delta_buf[:, off : off + chunk].reshape(-1)[: p.size]
+                )
+                new_p_leaves[i] = new_p.reshape(p.shape).astype(p.dtype)
+        new_state = {"count": count}
+        for j, name in enumerate(self.MOMENTS):
+            new_state[name] = jax.tree.unflatten(treedef, new_mom_leaves[j])
+        out = (jax.tree.unflatten(treedef, new_p_leaves), new_state)
+        if ef is None:
+            return out
+        return (*out, B.unflatten(new_ef_bufs, layout))
 
 
 class FsdpAdam(Zero1Adam):
@@ -810,7 +1104,20 @@ class FsdpAdam(Zero1Adam):
         tensor-shard shapes for tensor-sharded leaves (the trainer
         precomputes this local tree). Expert-parallel leaves (``specs``
         naming the data axis) pass through untouched — they are stored
-        at their natural local shape."""
+        at their natural local shape. With ``overlap`` the unshard is
+        bucketed on the REVERSE layout (``_gather_bucketed_flat``) —
+        overlap admits only pure-DP fsdp, so every leaf is replicated
+        and takes the bucketed route; its AD transpose delivers the
+        grad reduce-scatters bucket-by-bucket under the backward."""
+        if self.overlap and self.bucket_bytes and self.axis_size > 1:
+            return _gather_bucketed_flat(
+                shards,
+                shape_tree,
+                self.axis_name,
+                self.axis_size,
+                self.bucket_bytes,
+                reverse=True,
+            )
         if specs is None:
             return _gather_flat(shards, shape_tree, self.axis_name)
 

@@ -259,6 +259,10 @@ class Trainer:
                 DATA_AXIS,
                 self.axis_size,
                 bucket_bytes=self._bucket_bytes,
+                # Overlapped schedule: reverse-order buckets + per-bucket
+                # scatter/apply/gather lanes (validated below; an invalid
+                # sync_overlap string still raises before any trace).
+                overlap=cfg.sync_overlap != "off",
             )
         elif cfg.fused_optimizer:
             from cs744_pytorch_distributed_tutorial_tpu.ops.fused_sgd import FusedSGD
@@ -290,7 +294,22 @@ class Trainer:
             "int8_ring",
         )
         if self._compress:
-            if cfg.sync not in (
+            if cfg.sync == "zero1" and cfg.sync_overlap == "bucket+int8":
+                # zero1's quantized wire exists only inside the overlapped
+                # reduce-scatter schedule: quantization chunks and EF
+                # residuals are defined on the reverse-order bucket
+                # boundaries (Zero1SGD._apply_bucketed's int8 branch).
+                pass
+            elif cfg.sync == "fsdp":
+                raise ValueError(
+                    "grad_compress='int8' cannot ride sync='fsdp': its "
+                    "gradient reduction IS the AD transpose of the param "
+                    "all_gather (an XLA-inserted float psum_scatter), so "
+                    "there is no separate grad-sync pass to quantize; for "
+                    "a quantized sharded-optimizer wire use sync='zero1' "
+                    "with sync_overlap='bucket+int8'"
+                )
+            elif cfg.sync not in (
                 "allreduce",
                 "ring",
                 "int8_allreduce",
@@ -299,10 +318,11 @@ class Trainer:
                 raise ValueError(
                     "grad_compress='int8' applies to the flat allreduce "
                     "syncs only (allreduce, ring, int8_allreduce, "
-                    f"int8_ring); sync={cfg.sync!r} either has no grad-sync "
-                    "pass to compress (zero1/fsdp/auto/none) or exists to "
-                    "teach an uncompressed wire shape (gather_scatter, "
-                    "p2p_star)"
+                    f"int8_ring) or sync='zero1' with "
+                    f"sync_overlap='bucket+int8'; sync={cfg.sync!r} either "
+                    "has no grad-sync pass to compress (auto/none, zero1 "
+                    "without the overlapped schedule) or exists to teach "
+                    "an uncompressed wire shape (gather_scatter, p2p_star)"
                 )
             if cfg.fused_optimizer:
                 raise ValueError(
@@ -319,20 +339,17 @@ class Trainer:
             )
         self._overlap = cfg.sync_overlap != "off"
         if self._overlap:
-            if self._zero1 or self._fsdp or cfg.fused_optimizer:
+            if cfg.fused_optimizer:
                 raise ValueError(
                     f"sync_overlap={cfg.sync_overlap!r} replaces the "
                     "tree-wide optimizer apply with per-bucket updates; "
-                    f"sync={cfg.sync!r} fused_optimizer={cfg.fused_optimizer} "
-                    "supply their own update and cannot combine (zero1/fsdp "
-                    "shard the very state the bucket apply must see whole)"
+                    "fused_optimizer supplies its own whole-tree Pallas "
+                    "kernel and cannot combine"
                 )
-            if cfg.accum_steps != 1:
-                raise ValueError(
-                    "sync_overlap overlaps ONE backward with its sync; "
-                    f"accum_steps={cfg.accum_steps} syncs per microbatch "
-                    "on a different schedule — use the fused path"
-                )
+            # accum>1 composes: intermediate micro-steps stay local adds
+            # (microbatch_grads skips the per-microbatch sync under
+            # overlap) and only the FINAL micro-step's sync+apply runs
+            # the overlapped bucket schedule.
             if (
                 cfg.optimizer != "sgd"
                 or cfg.lr_schedule != "constant"
@@ -349,11 +366,17 @@ class Trainer:
                     "applied bucket-locally)"
                 )
             if cfg.sync_overlap == "bucket":
-                if self._compress or cfg.sync not in ("allreduce", "ring"):
+                if self._compress or cfg.sync not in (
+                    "allreduce",
+                    "ring",
+                    "zero1",
+                    "fsdp",
+                ):
                     raise ValueError(
                         "sync_overlap='bucket' overlaps the float bucketed "
-                        "wire: requires sync in ('allreduce', 'ring') and "
-                        f"grad_compress='none' (got sync={cfg.sync!r}, "
+                        "wire: requires sync in ('allreduce', 'ring', "
+                        "'zero1', 'fsdp') and grad_compress='none' (got "
+                        f"sync={cfg.sync!r}, "
                         f"grad_compress={cfg.grad_compress!r}; for the "
                         "quantized wire use sync_overlap='bucket+int8')"
                     )
@@ -601,7 +624,7 @@ class Trainer:
                 )
                 new_ef = jax.tree.map(lambda a: a[None], ef_out)
 
-            if self._overlap:
+            if self._overlap and not (self._zero1 or self._fsdp):
                 # Overlapped bucket pipeline: per-bucket collective +
                 # per-bucket SGD apply over reverse-order buckets — no
                 # tree-wide barrier between backward, sync, and apply, so
@@ -610,6 +633,8 @@ class Trainer:
                 # to the fused sync+optax chain for allreduce/ring
                 # (tests/test_sync_parity.py); int8 holds the trajectory
                 # bar. grads comes back as the synced mean (telemetry).
+                # (zero1/fsdp overlap rides INSIDE tx.apply/gather_params
+                # below: the per-bucket scatter->apply->gather schedule.)
                 ef_local = (
                     jax.tree.map(lambda a: a[0], state.ef)
                     if self._compress
@@ -643,10 +668,28 @@ class Trainer:
                 # update and returns replicated params + the local
                 # momentum chunk. Under fsdp grads are the already-
                 # scattered [1, chunk] sums and the update stays chunk-wise.
-                with jax.named_scope("graftscope/optimizer"):
-                    new_params, new_opt = tx.apply(
-                        state.params, state.opt_state, grads
-                    )
+                # With overlap the apply emits its own per-bucket
+                # scatter/apply/gather lanes, so the tree-wide optimizer
+                # scope would mislabel them — skip it there.
+                scope = (
+                    contextlib.nullcontext()
+                    if self._overlap
+                    else jax.named_scope("graftscope/optimizer")
+                )
+                with scope:
+                    if self._compress and self._zero1:
+                        # zero1's int8+EF wire: residuals thread through
+                        # the bucketed apply (quantization chunks live on
+                        # bucket boundaries), one residual tree per device.
+                        ef_local = jax.tree.map(lambda a: a[0], state.ef)
+                        new_params, new_opt, ef_out = tx.apply(
+                            state.params, state.opt_state, grads, ef=ef_local
+                        )
+                        new_ef = jax.tree.map(lambda a: a[None], ef_out)
+                    else:
+                        new_params, new_opt = tx.apply(
+                            state.params, state.opt_state, grads
+                        )
             else:
                 with jax.named_scope("graftscope/optimizer"):
                     updates, new_opt = tx.update(
@@ -888,7 +931,15 @@ class Trainer:
         # MICROBATCH under gradient accumulation; the compressed path
         # syncs the accumulated gradient once, and zero1 fuses its
         # reduce-scatter into the single sharded update.
-        syncs_per_step = 1 if (self._compress or self._zero1) else cfg.accum_steps
+        # (fsdp still gathers/scatters per MICROBATCH even overlapped —
+        # every microbatch differentiates through the param all_gather —
+        # while pure-DP overlap defers the only sync to the final
+        # micro-step.)
+        syncs_per_step = (
+            1
+            if (self._compress or self._zero1 or (self._overlap and not self._fsdp))
+            else cfg.accum_steps
+        )
         wire_bytes = syncs_per_step * sync_wire_bytes(
             state.params,
             cfg.sync,
@@ -1444,7 +1495,13 @@ def make_trace_entry(**overrides):
     key = jax.random.key(0)
 
     syncs_per_step = (
-        1 if (trainer._compress or trainer._zero1) else cfg.accum_steps
+        1
+        if (
+            trainer._compress
+            or trainer._zero1
+            or (trainer._overlap and not trainer._fsdp)
+        )
+        else cfg.accum_steps
     )
     if cfg.sync in ("auto", "none") and not compat.LEGACY_SHARD_MAP:
         # Framework-inserted sync: the averaging collectives come from the
@@ -1508,6 +1565,14 @@ def _cifar_overlap_entry():
     return make_trace_entry(sync_overlap="bucket")
 
 
+def _cifar_overlap_zero1_entry():
+    # Overlapped reduce-scatter schedule: per-bucket psum_scatter ->
+    # per-shard SGD apply -> per-bucket delta all_gather, reverse-order
+    # buckets, no cross-bucket barrier. TA003 checks the reduce_scatter
+    # and all_gather counts/bytes against the rows=axis_size layout.
+    return make_trace_entry(sync="zero1", sync_overlap="bucket")
+
+
 def _register_trace_entries() -> None:
     from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
         register_entrypoint,
@@ -1517,6 +1582,11 @@ def _register_trace_entries() -> None:
     register_entrypoint("cifar-int8", _cifar_int8_entry, tags=("cifar", "int8"))
     register_entrypoint(
         "cifar-overlap", _cifar_overlap_entry, tags=("cifar", "overlap")
+    )
+    register_entrypoint(
+        "cifar-overlap-zero1",
+        _cifar_overlap_zero1_entry,
+        tags=("cifar", "overlap", "zero1"),
     )
 
 

@@ -167,22 +167,28 @@ class LMConfig:
     # same semantics as the CIFAR engine's TrainConfig.grad_compress):
     # "int8" quantizes each gradient bucket per-chunk to int8 + f32
     # scales and carries the quantization residual as per-device error
-    # feedback inside the optimizer state. Pure-DP layouts only
-    # (tensor_parallel == seq_parallel == 1, no zero1/fsdp/EP): those
-    # paths ship grads on different wires (psum_scatter chunks, local
-    # tensor shards) that the bucket quantizer does not model. The clip
-    # still sees the dequantized mean (make_optimizer chains it first).
+    # feedback inside the optimizer state. Data-parallel layouts only
+    # (tensor_parallel == seq_parallel == 1, no EP): sharded-grad paths
+    # ship on wires the bucket quantizer does not model. zero1 composes
+    # via sync_overlap="bucket+int8" (quantization chunks on the
+    # overlapped schedule's bucket boundaries); fsdp has no separate
+    # grad wire to quantize (the reduction is the param all_gather's AD
+    # transpose). The clip still sees the dequantized mean.
     grad_compress: str = "none"  # "none" | "int8"
     # Bucket size (MiB) for the compressed sync's coalesced buffers;
     # 0 falls back to the default bucket size.
     sync_bucket_mb: float = 4.0
-    # Overlapped gradient sync (parallel/overlap.py): reverse-layer-order
-    # buckets, per-bucket collective + per-bucket SGD apply — DDP's
-    # reducer schedule as dataflow. "bucket" overlaps the float DP pmean;
-    # "bucket+int8" overlaps the int8+EF wire (grad_compress="int8").
-    # Pure-DP layouts only (same restriction as grad_compress) and the
-    # fixed-LR SGD recipe (optimizer="sgd", constant lr, no warmup/clip,
-    # accum_steps=1).
+    # Overlapped gradient sync (parallel/overlap.py, parallel/zero.py):
+    # reverse-layer-order buckets, per-bucket collective + per-bucket
+    # optimizer apply — DDP's reducer schedule as dataflow. "bucket"
+    # overlaps the float wire: the pure-DP pmean (fixed-LR SGD recipe
+    # required: optimizer="sgd", constant lr, no warmup/clip) or, under
+    # zero1/fsdp, the per-bucket psum_scatter -> chunk apply ->
+    # all_gather schedule inside the sharded optimizer (any registry
+    # optimizer + schedule; grad_clip_norm stays fused-only).
+    # "bucket+int8" overlaps the int8+EF wire (grad_compress="int8";
+    # pure DP or zero1). accum_steps>1 composes: only the final
+    # micro-step's sync overlaps. No seq/tensor/expert sharding.
     sync_overlap: str = "off"  # "off" | "bucket" | "bucket+int8"
 
     # Rematerialization: recompute block activations in backward instead
@@ -412,20 +418,36 @@ class LMTrainer:
                 "'none' or 'int8'"
             )
         self._compress = cfg.grad_compress == "int8"
-        if self._compress and (
-            self.seq_size > 1
-            or self.tensor_size > 1
-            or cfg.zero1
-            or cfg.fsdp
-            or self.expert_parallel
-        ):
-            raise ValueError(
-                "grad_compress='int8' requires a pure data-parallel layout "
-                "(tensor_parallel == seq_parallel == 1, no zero1/fsdp, no "
-                "expert parallelism): the quantized bucket all-reduce "
-                "models the plain data-axis gradient pmean, not "
-                "psum_scatter chunks or locally-sharded grads"
-            )
+        if self._compress:
+            if cfg.fsdp:
+                raise ValueError(
+                    "grad_compress='int8' cannot ride fsdp: its gradient "
+                    "reduction IS the AD transpose of the param all_gather "
+                    "(an XLA-inserted float psum_scatter), so there is no "
+                    "separate grad-sync pass to quantize; for a quantized "
+                    "sharded-optimizer wire use zero1 with "
+                    "sync_overlap='bucket+int8'"
+                )
+            if (
+                self.seq_size > 1
+                or self.tensor_size > 1
+                or self.expert_parallel
+            ):
+                raise ValueError(
+                    "grad_compress='int8' requires a data-parallel layout "
+                    "(tensor_parallel == seq_parallel == 1, no expert "
+                    "parallelism): the quantized bucket all-reduce models "
+                    "the plain data-axis gradient reduction, not "
+                    "locally-sharded grads"
+                )
+            if cfg.zero1 and cfg.sync_overlap != "bucket+int8":
+                raise ValueError(
+                    "grad_compress='int8' under zero1 quantizes on the "
+                    "overlapped schedule's bucket boundaries "
+                    "(Zero1Adam._apply_overlapped): arm it with "
+                    "sync_overlap='bucket+int8' (the fused zero1 path has "
+                    "no separate grad-sync pass to compress)"
+                )
         if cfg.sync_bucket_mb < 0:
             raise ValueError(
                 f"sync_bucket_mb must be >= 0, got {cfg.sync_bucket_mb}"
@@ -445,36 +467,33 @@ class LMTrainer:
             if (
                 self.seq_size > 1
                 or self.tensor_size > 1
-                or cfg.zero1
-                or cfg.fsdp
                 or self.expert_parallel
             ):
                 raise ValueError(
-                    "sync_overlap requires a pure data-parallel layout "
-                    "(tensor_parallel == seq_parallel == 1, no zero1/fsdp, "
-                    "no expert parallelism): the per-bucket schedule models "
-                    "the plain data-axis gradient pmean, not psum_scatter "
-                    "chunks or locally-sharded grads"
+                    "sync_overlap requires a data-parallel layout "
+                    "(tensor_parallel == seq_parallel == 1, no expert "
+                    "parallelism): seq/tensor/expert sharding needs "
+                    "cross-chunk joins (psums over other axes) that "
+                    "defeat the per-bucket schedule"
                 )
-            if cfg.accum_steps != 1:
-                raise ValueError(
-                    "sync_overlap requires accum_steps=1: the per-bucket "
-                    "apply consumes each bucket as backward produces it, "
-                    "which an accumulation scan would serialize anyway"
-                )
-            if (
+            # accum>1 composes: intermediate micro-steps stay local adds
+            # and only the FINAL micro-step's sync+apply runs the
+            # overlapped bucket schedule.
+            if not (cfg.zero1 or cfg.fsdp) and (
                 cfg.optimizer != "sgd"
                 or cfg.lr_schedule != "constant"
                 or cfg.warmup_steps
                 or cfg.grad_clip_norm is not None
             ):
                 raise ValueError(
-                    "sync_overlap requires the reference's fixed-LR SGD "
-                    "recipe (optimizer='sgd', lr_schedule='constant', "
+                    "pure-DP sync_overlap requires the reference's fixed-LR "
+                    "SGD recipe (optimizer='sgd', lr_schedule='constant', "
                     "warmup_steps=0, grad_clip_norm=None): the per-bucket "
                     "apply is the flat torch-SGD update, and a clip or "
                     "schedule would reintroduce the tree-wide barrier the "
-                    "overlap removes"
+                    "overlap removes. zero1/fsdp overlap admits any "
+                    "registry optimizer and LR schedule (the sharded "
+                    "optimizers apply their chunk rules per bucket)"
                 )
             if cfg.sync_overlap == "bucket" and self._compress:
                 raise ValueError(
@@ -603,6 +622,8 @@ class LMTrainer:
                     else None
                 ),
                 clip_norm=cfg.grad_clip_norm,
+                bucket_bytes=self._bucket_bytes,
+                overlap=self._overlap,
             )
             # The original (tensor-aware) specs drive the chunk layout;
             # chunked leaves shard [dp, chunk] over data or
@@ -1062,11 +1083,28 @@ class LMTrainer:
                 # pmean runs on the 1/dp chunk inside. The original
                 # specs tell it which leaves are tensor shards (chunked
                 # per (data, tensor) coordinate) and drive the exact
-                # global-norm clip when configured.
-                with jax.named_scope("graftscope/optimizer_zero1"):
-                    params, opt_state = zero1_opt.apply(
-                        params, opt_state, grads, orig_specs
-                    )
+                # global-norm clip when configured. With overlap the
+                # apply emits its own per-bucket scatter/apply/gather
+                # lanes, so the tree-wide scope would mislabel them.
+                scope = (
+                    contextlib.nullcontext()
+                    if overlap
+                    else jax.named_scope("graftscope/optimizer_zero1")
+                )
+                with scope:
+                    if compress:
+                        # zero1's int8+EF wire (sync_overlap='bucket+int8'):
+                        # residuals thread through the bucketed apply.
+                        ef_local = jax.tree.map(lambda a: a[0], ef)
+                        params, opt_state, ef_out = zero1_opt.apply(
+                            params, opt_state, grads, orig_specs,
+                            ef=ef_local,
+                        )
+                        ef = jax.tree.map(lambda a: a[None], ef_out)
+                    else:
+                        params, opt_state = zero1_opt.apply(
+                            params, opt_state, grads, orig_specs
+                        )
             elif overlap:
                 # Overlapped schedule (parallel/overlap.py): per-bucket
                 # sync + per-bucket torch-SGD apply over reverse-order
@@ -1347,18 +1385,22 @@ class LMTrainer:
         # tensor/seq-axis collectives (activations, f/g boundaries) are
         # deliberately out of scope — this ledger tracks the DP wire the
         # compression strategies target.
-        if self._compress:
-            dp_strategy = "int8_allreduce"
-        elif cfg.fsdp:
+        if cfg.fsdp:
             dp_strategy = "fsdp"
         elif self._zero1_opt is not None:
+            # grad_compress routes the accounting to the zero1_int8 wire
+            # (quantized scatter + float delta gather) inside
+            # sync_wire_bytes.
             dp_strategy = "zero1"
+        elif self._compress:
+            dp_strategy = "int8_allreduce"
         else:
             dp_strategy = "allreduce"
         wire_bytes = sync_wire_bytes(
             params,
             dp_strategy,
             self.data_size,
+            cfg.grad_compress,
             bucket_bytes=self._bucket_bytes,
             overlap=self._overlap,
         )
@@ -1640,12 +1682,12 @@ def make_lm_trace_entry(**overrides):
     step = jnp.int32(0)
 
     # Mirror fit()'s dp_strategy resolution and wire accounting exactly.
-    if trainer._compress:
-        dp_strategy = "int8_allreduce"
-    elif cfg.fsdp:
+    if cfg.fsdp:
         dp_strategy = "fsdp"
     elif trainer._zero1_opt is not None:
         dp_strategy = "zero1"
+    elif trainer._compress:
+        dp_strategy = "int8_allreduce"
     else:
         dp_strategy = "allreduce"
     # The LM sync is per-LEAF for every uncompressed path (sync_grad /
@@ -1673,6 +1715,7 @@ def make_lm_trace_entry(**overrides):
         params,
         dp_strategy,
         trainer.data_size,
+        cfg.grad_compress,
         bucket_bytes=trainer._bucket_bytes,
         overlap=trainer._overlap,
     )
@@ -1697,9 +1740,17 @@ def make_lm_trace_entry(**overrides):
 
 
 def _lm_overlap_entry():
-    # The overlapped schedule needs the fixed-LR SGD recipe (LM defaults
-    # to adamw).
+    # The pure-DP overlapped schedule needs the fixed-LR SGD recipe (LM
+    # defaults to adamw).
     return make_lm_trace_entry(optimizer="sgd", sync_overlap="bucket")
+
+
+def _lm_overlap_fsdp_entry():
+    # Overlapped reduce-scatter schedule under fsdp: the forward gathers
+    # params per reverse-order bucket (so the AD transpose scatters the
+    # grads per bucket) and the sharded AdamW applies chunk-wise. TA003
+    # checks the per-bucket reduce_scatter/all_gather counts and bytes.
+    return make_lm_trace_entry(fsdp=True, sync_overlap="bucket")
 
 
 def _register_lm_trace_entries() -> None:
@@ -1710,6 +1761,11 @@ def _register_lm_trace_entries() -> None:
     register_entrypoint("lm", make_lm_trace_entry, tags=("lm",))
     register_entrypoint(
         "lm-overlap", _lm_overlap_entry, tags=("lm", "overlap")
+    )
+    register_entrypoint(
+        "lm-overlap-fsdp",
+        _lm_overlap_fsdp_entry,
+        tags=("lm", "overlap", "fsdp"),
     )
 
 

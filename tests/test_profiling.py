@@ -126,8 +126,19 @@ def _cifar_step_inputs(mesh, cfg):
         ("allreduce", "none", {"sync_bucket_mb": 0}),  # per-leaf
         ("ring", "none", {}),
         ("allreduce", "int8", {}),
+        pytest.param(  # fused scatter/apply/gather
+            "zero1", "none", {}, marks=pytest.mark.slow
+        ),
+        ("zero1", "none", {"sync_overlap": "bucket"}),
+        pytest.param(
+            "zero1", "int8", {"sync_overlap": "bucket+int8"},
+            marks=pytest.mark.slow,
+        ),
     ],
-    ids=["allreduce", "allreduce-perleaf", "ring", "int8"],
+    ids=[
+        "allreduce", "allreduce-perleaf", "ring", "int8",
+        "zero1", "zero1-overlap", "zero1-int8",
+    ],
 )
 def test_segmented_fused_parity_cifar(mesh4, sync, compress, overrides):
     """The segmented profiled step (forward/grads | sync | opt as separate
@@ -203,16 +214,31 @@ def test_segmented_fused_parity_lm(compress):
         )
 
 
-def test_cifar_segments_reject_sharded_optimizers(mesh4):
-    """Segmentation only covers the plain-DP step; sharded-state configs
-    must fail loudly, not silently mis-attribute."""
+def test_cifar_segments_reject_fsdp(mesh4):
+    """fsdp's gradient reduction is the AD transpose of its parameter
+    all_gather — there is no separable sync phase, so segmentation must
+    fail loudly, not silently mis-attribute. (zero1 IS segmentable:
+    see the zero1 cases in the parity sweep above.)"""
     from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
         build_cifar_segments,
     )
 
-    cfg = TrainConfig(**TINY_DP4_CFG, sync="zero1")
+    cfg = TrainConfig(**TINY_DP4_CFG, sync="fsdp")
     tr = Trainer(cfg, mesh=mesh4)
-    with pytest.raises(ValueError, match="sync='zero1'"):
+    with pytest.raises(ValueError, match="fsdp"):
+        build_cifar_segments(tr)
+
+
+def test_cifar_segments_reject_unbucketed_zero1(mesh4):
+    """zero1 segmentation carves the BUCKETED schedule; the per-leaf
+    fallback (sync_bucket_mb=0) has no bucket lanes to time."""
+    from cs744_pytorch_distributed_tutorial_tpu.obs.phases import (
+        build_cifar_segments,
+    )
+
+    cfg = TrainConfig(**TINY_DP4_CFG, sync="zero1", sync_bucket_mb=0)
+    tr = Trainer(cfg, mesh=mesh4)
+    with pytest.raises(ValueError, match="bucket"):
         build_cifar_segments(tr)
 
 

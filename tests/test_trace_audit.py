@@ -107,24 +107,32 @@ def test_ta003_cifar_schedule_matches_contract(sync, devices):
 
 
 OVERLAP_CONFIGS = [
-    ("allreduce", "bucket"),
-    ("ring", "bucket"),
-    ("int8_allreduce", "bucket+int8"),
+    ("allreduce", "bucket", {}),
+    ("ring", "bucket", {}),
+    ("int8_allreduce", "bucket+int8", {}),
+    ("zero1", "bucket", {}),
+    ("fsdp", "bucket", {}),
+    ("zero1", "bucket+int8", {"grad_compress": "int8"}),
 ]
 
 
-@pytest.mark.parametrize("sync,overlap", OVERLAP_CONFIGS)
-def test_ta003_overlapped_schedule_matches_contract(sync, overlap, devices):
+@pytest.mark.parametrize("sync,overlap,extra", OVERLAP_CONFIGS)
+def test_ta003_overlapped_schedule_matches_contract(
+    sync, overlap, extra, devices
+):
     """The overlapped bucket schedule (--sync-overlap) keeps TA003's
     contract byte-exact: the same collective classes and wire bytes as
     the fused bucketed wire, just placed per reverse-order bucket
     (sync_units/sync_wire_bytes count the reverse layout when
-    overlap=True)."""
+    overlap=True). Covers the sharded schedules too: zero1/fsdp run the
+    per-bucket psum_scatter -> chunk apply -> all_gather chain, and
+    zero1+int8 swaps each scatter for the quantized allreduce
+    (2 all_to_alls + 2 all_gathers per unit, plus the delta gather)."""
     from cs744_pytorch_distributed_tutorial_tpu.train.engine import (
         make_trace_entry,
     )
 
-    step = make_trace_entry(sync=sync, sync_overlap=overlap)
+    step = make_trace_entry(sync=sync, sync_overlap=overlap, **extra)
     closed = jax.make_jaxpr(step.fn)(*step.args)
     colls = jaxpr_utils.collect_collectives(closed, step.axis_sizes)
     counts = jaxpr_utils.schedule_counts(colls)
@@ -147,20 +155,35 @@ def test_ta003_overlapped_schedule_matches_contract(sync, overlap, devices):
         assert step.expected_wire_bytes == fused.expected_wire_bytes
 
 
-def test_ta003_lm_overlapped_schedule(devices):
+LM_OVERLAP_MODES = {
+    "dp-sgd": (dict(optimizer="sgd"), "bucket"),
+    "zero1": (dict(zero1=True), "bucket"),
+    "fsdp": (dict(fsdp=True), "bucket"),
+    "zero1-int8": (dict(zero1=True, grad_compress="int8"), "bucket+int8"),
+}
+
+
+@pytest.mark.parametrize("mode", sorted(LM_OVERLAP_MODES))
+def test_ta003_lm_overlapped_schedule(mode, devices):
+    """LM overlap sweep: pure-DP SGD plus the sharded schedules (which
+    admit any registry optimizer — these trace the default AdamW)."""
     from cs744_pytorch_distributed_tutorial_tpu.train.lm import (
         make_lm_trace_entry,
     )
 
-    step = make_lm_trace_entry(optimizer="sgd", sync_overlap="bucket")
+    kw, overlap = LM_OVERLAP_MODES[mode]
+    step = make_lm_trace_entry(sync_overlap=overlap, **kw)
     closed = jax.make_jaxpr(step.fn)(*step.args)
     colls = jaxpr_utils.collect_collectives(closed, step.axis_sizes)
     counts = jaxpr_utils.schedule_counts(colls)
     expected = {k: v for k, v in step.expected_schedule.items() if v}
-    assert counts == expected, f"lm-overlap: {counts} != {expected}"
+    assert counts == expected, f"lm-{mode}: {counts} != {expected}"
     wire = jaxpr_utils.total_wire_bytes(colls)
     tol = max(0.01 * step.expected_wire_bytes, 512.0)
-    assert abs(wire - step.expected_wire_bytes) <= tol
+    assert abs(wire - step.expected_wire_bytes) <= tol, (
+        f"lm-{mode}: jaxpr wire {wire} vs accounting "
+        f"{step.expected_wire_bytes}"
+    )
 
 
 def test_ta003_int8_wire_beats_f32(devices):
@@ -431,7 +454,8 @@ def test_registry_unknown_name_lists_known():
 def test_builtin_entrypoints_load():
     load_builtin_entrypoints()
     names = {e.name for e in get_entrypoints()}
-    assert {"cifar", "cifar-int8", "cifar-overlap", "lm", "lm-overlap",
+    assert {"cifar", "cifar-int8", "cifar-overlap", "cifar-overlap-zero1",
+            "lm", "lm-overlap", "lm-overlap-fsdp",
             "lm-serve", "lm-serve-paged"} <= names
 
 
@@ -439,14 +463,15 @@ def test_clean_repo_audits_green(devices):
     """The acceptance gate: every registered entrypoint audits clean."""
     load_builtin_entrypoints()
     entries = get_entrypoints(
-        ["cifar", "cifar-int8", "cifar-overlap", "lm", "lm-overlap"]
+        ["cifar", "cifar-int8", "cifar-overlap", "cifar-overlap-zero1",
+         "lm", "lm-overlap", "lm-overlap-fsdp"]
     )
     findings, _suppressed, summaries, _sources, errors = run_audits(
         entries, ALL_RULES
     )
     assert errors == []
     assert findings == []
-    assert len(summaries) == 5
+    assert len(summaries) == 7
     for s in summaries:
         assert s["donation"]["donated"] == s["donation"]["aliased"]
 
