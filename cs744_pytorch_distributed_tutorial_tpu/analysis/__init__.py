@@ -22,6 +22,24 @@ Python linter sees:
 - **GL007 unguarded-time-in-trace** — ``time.time()``-style host clock
   reads baked into traced code (they freeze at trace time).
 - **GL008 dead-import** — module-level imports never used.
+- **GL009 blocking-sync-in-step-loop** — unconditional device fetches
+  inside the host-side step loop.
+
+The **graftrank** family (``analysis/rank.py``) audits the *cross-rank*
+invariants of the elastic multi-process runtime via rank-taint analysis
+(values derived from ``rank``/``process_index()``/coordinator flags,
+heartbeat and death-note reads, or ``os.environ``):
+
+- **GR001 rank-divergent-collective** — rank-tainted branches guarding
+  collectives / store barriers / ``append_event`` on one side only.
+- **GR002 conditional-barrier-skip** — early ``return``/``raise`` edges
+  that skip a store barrier other ranks will wait at.
+- **GR003 blocking-io-under-lock** — collectives or blocking
+  rendezvous-store I/O while holding a ``threading.Lock``.
+- **GR004 wall-clock-cross-rank** — ``time.time()`` in heartbeat-age or
+  cross-rank ordering math where monotonic stamps exist.
+- **GR005 unlocked-shared-mutation** — mutating state a registered
+  background thread reads, outside the lock that guards it.
 
 Usage::
 

@@ -8,8 +8,11 @@ accepted residual and exits 0. ``--fix`` applies the safe auto-fixes
 (GL008 dead-import removal) before linting and reports what remains.
 
 ``python -m ...analysis trace [...]`` dispatches to graftcheck, the
-trace-audit suite over the registered step functions (TA001-TA005,
+trace-audit suite over the registered step functions (TA001-TA006,
 ``analysis/trace/cli.py``).
+
+``--select``/``--disable`` take rule ids or bare family prefixes —
+``--select GR`` runs every graftrank rule.
 """
 
 from __future__ import annotations
@@ -27,7 +30,7 @@ from cs744_pytorch_distributed_tutorial_tpu.analysis.rules import ALL_RULES
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="JAX/TPU-aware static analysis (GL001-GL008).",
+        description="JAX/TPU-aware static analysis (GL001-GL009, GR001-GR005).",
     )
     p.add_argument(
         "paths",
@@ -78,6 +81,30 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _expand_rule_ids(
+    raw: list[str], known: dict, strict: bool = True
+) -> set[str] | None:
+    """Normalize a rule-id list: a bare family prefix (``GL``, ``GR``)
+    selects every rule of that family. Returns None (after printing) on
+    unknown ids when ``strict``."""
+    out: set[str] = set()
+    unknown: set[str] = set()
+    for token in raw:
+        rid = token.strip().upper()
+        if not rid:
+            continue
+        if rid in known:
+            out.add(rid)
+        elif any(k.startswith(rid) for k in known):
+            out.update(k for k in known if k.startswith(rid))
+        else:
+            unknown.add(rid)
+    if unknown and strict:
+        print(f"graftlint: unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+        return None
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -108,16 +135,18 @@ def main(argv: list[str] | None = None) -> int:
 
     rules = dict(ALL_RULES)
     if args.select:
-        wanted = {r.strip().upper() for r in args.select.split(",") if r.strip()}
-        unknown = wanted - rules.keys()
-        if unknown:
-            print(f"graftlint: unknown rule(s): {sorted(unknown)}", file=sys.stderr)
+        wanted = _expand_rule_ids(args.select.split(","), rules)
+        if wanted is None:
             return 2
         rules = {rid: fn for rid, fn in rules.items() if rid in wanted}
-    for rid in list(args.disable.split(",") if args.disable else []) + list(
-        config.disable
-    ):
-        rules.pop(rid.strip().upper(), None)
+    disabled = _expand_rule_ids(
+        list(args.disable.split(",") if args.disable else [])
+        + list(config.disable),
+        ALL_RULES,
+        strict=False,
+    )
+    for rid in disabled or ():
+        rules.pop(rid, None)
 
     if args.fix:
         from cs744_pytorch_distributed_tutorial_tpu.analysis.fix import fix_paths

@@ -48,11 +48,12 @@ class Finding:
 
 
 # --------------------------------------------------------------- suppressions
-# GLxxx are the AST lint rules; TAxxx are graftcheck's trace-audit rules,
-# which anchor to register_entrypoint() call sites and reuse this machinery.
+# GLxxx are the AST lint rules; GRxxx the graftrank cross-rank rules;
+# TAxxx are graftcheck's trace-audit rules, which anchor to
+# register_entrypoint() call sites and reuse this machinery.
 _SUPPRESS_RE = re.compile(
     r"graftlint:\s*(?P<kind>disable-file|disable)\s*=\s*"
-    r"(?P<rules>(?:(?:GL|TA)\d+|all)(?:\s*,\s*(?:(?:GL|TA)\d+|all))*)"
+    r"(?P<rules>(?:(?:GL|TA|GR)\d+|all)(?:\s*,\s*(?:(?:GL|TA|GR)\d+|all))*)"
     r"(?:\s+--\s*(?P<reason>.*))?",
 )
 
@@ -63,7 +64,9 @@ class Suppressions:
     A trailing comment suppresses findings on its own line; a comment
     that is the whole line suppresses the next CODE line below it,
     skipping blank and comment-only lines (so a pragma can live anywhere
-    in the comment block above a multi-line statement).
+    in the comment block above a multi-line statement). A standalone
+    pragma with NO code line after it (end of file) applies file-wide —
+    silently binding to nothing would be worse than either reading.
     ``disable-file=`` anywhere suppresses the rule(s) file-wide.
     """
 
@@ -96,6 +99,11 @@ class Suppressions:
                 target += 1
                 while target <= len(lines) and not _is_code(target):
                     target += 1
+                if target > len(lines):
+                    # Nothing follows (trailing pragma at end of file):
+                    # apply file-wide rather than bind to no line at all.
+                    self.file_wide |= rules
+                    continue
             self.by_line.setdefault(target, set()).update(rules)
 
     def is_suppressed(self, finding: Finding) -> bool:

@@ -58,7 +58,9 @@ def lint_source(
     sup = Suppressions(src)
     active: list[Finding] = []
     suppressed = 0
-    for rule_fn in (rules or ALL_RULES).values():
+    # `is None` — not truthiness — so an empty dict (every rule disabled)
+    # means "run nothing", not "run everything".
+    for rule_fn in (ALL_RULES if rules is None else rules).values():
         for finding in rule_fn(ctx):
             if sup.is_suppressed(finding):
                 suppressed += 1

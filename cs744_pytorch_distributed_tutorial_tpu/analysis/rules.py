@@ -1027,3 +1027,12 @@ ALL_RULES: dict[str, RuleFn] = {
     "GL008": check_dead_import,
     "GL009": check_blocking_sync_in_step_loop,
 }
+
+# graftrank (GR001–GR005): cross-rank divergence and distributed-deadlock
+# rules, defined in their own module — they share the engine, pragma and
+# baseline machinery with the GL family.
+from cs744_pytorch_distributed_tutorial_tpu.analysis.rank import (  # noqa: E402
+    RANK_RULES,
+)
+
+ALL_RULES.update(RANK_RULES)

@@ -14,6 +14,10 @@ TA004     large-trace-constant           big arrays closure-captured into the
                                          trace instead of passed as arguments
 TA005     dead-expensive-eqn             matmuls/collectives whose outputs reach
                                          no jaxpr output
+TA006     branch-collective-mismatch     ``lax.cond``/``lax.switch`` branches
+                                         that lower different collective
+                                         schedules — a rank-dependent predicate
+                                         would hang the peers
 ========  =============================  =======================================
 
 Findings are anchored to the entry's ``register_entrypoint`` call site, so
@@ -47,6 +51,7 @@ TRACE_RULES: dict[str, str] = {
     "TA003": "collective-schedule-mismatch",
     "TA004": "large-trace-constant",
     "TA005": "dead-expensive-eqn",
+    "TA006": "branch-collective-mismatch",
 }
 
 #: sites where an f32 matmul under mixed precision is deliberate policy:
@@ -269,6 +274,39 @@ def audit_dead_computation(
     return out
 
 
+# ---------------------------------------------------------------------- TA006
+def audit_branch_divergence(
+    entry: TraceEntry, step: TracedStep, closed_jaxpr
+) -> list[Finding]:
+    """Diff the per-branch collective schedule of every ``lax.cond`` /
+    ``lax.switch`` in the trace. The branches of one cond are the SAME
+    program point on every rank — if they lower different collective
+    sequences and the predicate ever disagrees across ranks (rank-keyed
+    config, data-dependent thresholds), the ranks that took the quiet
+    branch hang the ranks blocked in the chatty one. This is the
+    in-program twin of graftrank's GR001."""
+    out: list[Finding] = []
+    for eqn, mult, schedules in jaxpr_utils.cond_branch_schedules(
+        closed_jaxpr, step.axis_sizes
+    ):
+        if all(s == schedules[0] for s in schedules[1:]):
+            continue
+        frames = jaxpr_utils.eqn_frames(eqn)
+        desc = " vs ".join(str(s if s else {}) for s in schedules)
+        out.append(
+            _finding(
+                entry,
+                "TA006",
+                f"cond/switch branches lower DIFFERENT collective "
+                f"schedules ({desc}, x{mult}) — any cross-rank "
+                f"disagreement in the predicate desynchronizes the "
+                f"collective schedule and hangs the job; traced at "
+                f"{_frames_str(frames)}",
+            )
+        )
+    return out
+
+
 # ---------------------------------------------------------------- entry audit
 def audit_entry(
     entry: TraceEntry, rules: set[str] | None = None
@@ -302,6 +340,8 @@ def audit_entry(
         findings += audit_trace_constants(entry, step, closed_jaxpr)
     if "TA005" in active:
         findings += audit_dead_computation(entry, step, closed_jaxpr)
+    if "TA006" in active:
+        findings += audit_branch_divergence(entry, step, closed_jaxpr)
     summary["findings"] = len(findings)
     return findings, summary
 

@@ -58,7 +58,7 @@ def _configure_platform() -> None:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftcheck",
-        description="jaxpr/compiled-executable trace audits (TA001-TA005).",
+        description="jaxpr/compiled-executable trace audits (TA001-TA006).",
     )
     p.add_argument(
         "entries",
@@ -133,8 +133,19 @@ def main(argv: list[str] | None = None) -> int:
     for flag, keep in ((args.select, True), (args.disable, False)):
         if not flag:
             continue
-        named = {r.strip().upper() for r in flag.split(",") if r.strip()}
-        unknown = named - set(TRACE_RULES)
+        named: set[str] = set()
+        unknown: set[str] = set()
+        for token in flag.split(","):
+            rid = token.strip().upper()
+            if not rid:
+                continue
+            if rid in TRACE_RULES:
+                named.add(rid)
+            elif any(k.startswith(rid) for k in TRACE_RULES):
+                # bare family prefix ("TA") selects the whole family
+                named.update(k for k in TRACE_RULES if k.startswith(rid))
+            else:
+                unknown.add(rid)
         if unknown:
             print(
                 f"graftcheck: unknown rule(s): {sorted(unknown)}",

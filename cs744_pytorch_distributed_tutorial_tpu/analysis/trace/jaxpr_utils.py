@@ -187,6 +187,37 @@ def collect_collectives(
     return out
 
 
+def cond_branch_schedules(
+    closed_jaxpr, axis_sizes: dict[str, int]
+) -> list[tuple[Any, int, list[dict[str, int]]]]:
+    """``(eqn, mult, per-branch collective counts)`` for every ``cond``
+    equation (both ``lax.cond`` and ``lax.switch`` lower to it) anywhere
+    in the trace.
+
+    Unlike :func:`schedule_counts`, scalar-payload collectives are NOT
+    filtered here: a size-1 ``psum`` present in only one branch still
+    hangs the ranks that took the other branch — only group-of-one
+    (single-device) collectives are ignored. Counts are scan-multiplied
+    *within* the branch; the returned ``mult`` is the enclosing
+    multiplier of the ``cond`` itself."""
+    out: list[tuple[Any, int, list[dict[str, int]]]] = []
+    for eqn, mult in iter_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = eqn.params.get("branches") or ()
+        schedules: list[dict[str, int]] = []
+        for br in branches:
+            counts: dict[str, int] = {}
+            for c in collect_collectives(br, axis_sizes):
+                if c.group_size <= 1:
+                    continue
+                counts[c.cls] = counts.get(c.cls, 0) + c.mult
+            schedules.append(counts)
+        if schedules:
+            out.append((eqn, mult, schedules))
+    return out
+
+
 def schedule_counts(collectives: list[CollectiveEqn]) -> dict[str, int]:
     """Gradient-class collective counts by canonical class: non-trivial
     (payload beyond a scalar, group beyond one device) eqns, scan-

@@ -366,6 +366,9 @@ class RendezvousStore:
         beat = rec.get("time")
         if not isinstance(beat, (int, float)):
             return None
+        # graftlint: disable=GR004 -- deliberate cross-host wall path:
+        # CLOCK_MONOTONIC is per-boot, so beats from another machine can
+        # only be aged against wall time (documented above).
         return (time.time() if now is None else now) - float(beat)
 
     # -- death notes
@@ -860,16 +863,25 @@ def launch_local(
         # -- monitor until the generation completes or a death shows up
         while procs and not dead:
             time.sleep(poll_s)
+            # One monotonic "now" per sweep (the watchdog's discipline):
+            # every rank's age is measured against the same instant, so
+            # staleness decisions within a sweep are mutually consistent.
+            sweep_mono = time.monotonic()
             for global_rank, proc in list(procs.items()):
                 rc = proc.poll()
                 if rc is None:
-                    age = store.heartbeat_age(generation, global_rank)
+                    age = store.heartbeat_age(
+                        generation, global_rank, now_mono=sweep_mono
+                    )
                     stale = (
                         age is not None and age > heartbeat_deadline_s
                     ) or (
                         age is None
                         and time.monotonic() - spawned > startup_grace_s
                     )
+                    # graftlint: disable=GR001 -- the supervisor is ONE
+                    # process observing all ranks, not a rank: its
+                    # event appends cannot diverge across peers.
                     if stale:
                         proc.kill()
                         proc.wait()
@@ -965,6 +977,8 @@ def launch_local(
 
         plan = plan_next_generation(world, dead)
         survivors = plan["ranks"]
+        # graftlint: disable=GR001 -- single-process supervisor: giveup
+        # events are written once, not per rank.
         if not survivors:
             store.append_event(
                 "recovery_giveup",

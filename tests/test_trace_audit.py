@@ -601,3 +601,99 @@ def test_cli_dispatch_from_analysis_main(capsys):
 
     assert analysis_main(["trace", "--list-rules"]) == 0
     assert "TA001" in capsys.readouterr().out
+
+
+# ================================================ TA006 branch divergence
+def _cond_entry(mesh4, sync_branch, skip_branch):
+    def step(x):
+        def body(v):
+            return jax.lax.cond(v[0, 0] > 0, sync_branch, skip_branch, v)
+
+        return jax.shard_map(
+            body,
+            mesh=mesh4,
+            in_specs=jax.sharding.PartitionSpec("data"),
+            out_specs=jax.sharding.PartitionSpec("data"),
+        )(x)
+
+    return TracedStep(
+        name="cond-fixture",
+        fn=step,
+        args=(jnp.zeros((4, 128), jnp.float32),),
+        axis_sizes={"data": 4},
+        check_donation=False,
+    )
+
+
+def test_ta006_flags_divergent_cond(mesh4):
+    """A cond that psums in one branch only desynchronizes the ranks."""
+    step = _cond_entry(
+        mesh4,
+        lambda u: u + jax.lax.psum(u, "data"),
+        lambda u: u * 2.0,
+    )
+    findings = audit(step)
+    assert [f.rule for f in findings] == ["TA006"]
+    assert "psum" in findings[0].message
+
+
+def test_ta006_matched_branches_are_fine(mesh4):
+    """Both branches lowering the same collective schedule is legal —
+    every rank runs exactly one psum whichever way the predicate goes."""
+    step = _cond_entry(
+        mesh4,
+        lambda u: u + jax.lax.psum(u, "data"),
+        lambda u: u - jax.lax.psum(u, "data"),
+    )
+    assert audit(step) == []
+
+
+def test_ta006_counts_scalar_collectives(mesh4):
+    """Unlike TA003's schedule contract, TA006 must NOT drop
+    scalar-payload collectives: a 4-byte psum in one branch still hangs
+    the branch that skips it."""
+    step = _cond_entry(
+        mesh4,
+        lambda u: u + jax.lax.psum(u.sum(), "data"),
+        lambda u: u * 2.0,
+    )
+    findings = audit(step, rules={"TA006"})
+    assert [f.rule for f in findings] == ["TA006"]
+
+
+def test_ta006_flags_divergent_switch(mesh4):
+    """lax.switch lowers to the same cond primitive; a divergent branch
+    list is caught the same way."""
+
+    def step(x):
+        def body(v):
+            idx = (v[0, 0] > 0).astype(jnp.int32) + (v[0, 1] > 0).astype(
+                jnp.int32
+            )
+            return jax.lax.switch(
+                idx,
+                [
+                    lambda u: u * 2.0,
+                    lambda u: u + jax.lax.psum(u, "data"),
+                    lambda u: u + jax.lax.psum(u, "data"),
+                ],
+                v,
+            )
+
+        return jax.shard_map(
+            body,
+            mesh=mesh4,
+            in_specs=jax.sharding.PartitionSpec("data"),
+            out_specs=jax.sharding.PartitionSpec("data"),
+        )(x)
+
+    step = TracedStep(
+        name="switch-fixture",
+        fn=step,
+        args=(jnp.zeros((4, 128), jnp.float32),),
+        axis_sizes={"data": 4},
+        check_donation=False,
+    )
+    findings = audit(step, rules={"TA006"})
+    assert [f.rule for f in findings] == ["TA006"]
+    assert "3 branch" in findings[0].message or "branches" in findings[0].message
