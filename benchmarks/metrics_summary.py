@@ -14,6 +14,10 @@ pretty-printed object; its ``records`` list flattens into the stream)
 and summarizes its ``fleet_skew`` / ``fleet_incident`` /
 ``fleet_summary`` rows: per-step collective-skew attribution with a
 straggler histogram, incident counts, and the run-level audit line.
+The graftmem ``memory_report.json`` artifact flattens the same way:
+its ``kind:"memory_ledger"`` rows render one ``hbm <entry>`` line per
+registered entrypoint — per-device HBM bytes, donation-alias savings,
+and any replicated-leaf count TA008 found.
 """
 
 from __future__ import annotations
@@ -244,6 +248,20 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
             fleet_incidents[r["event"]] = (
                 fleet_incidents.get(r["event"], 0) + 1
             )
+    # graftmem rows (analysis/trace/memory.py memory_report.json,
+    # flattened by load_records): the compiled per-device HBM ledger of
+    # each registered entrypoint, latest record per entry wins.
+    memory: dict[str, dict[str, Any]] = {}
+    for r in records:
+        if r.get("kind") == "memory_ledger" and isinstance(
+            r.get("entry"), str
+        ):
+            memory[r["entry"]] = {
+                k: r.get(k)
+                for k in ("devices", "argument_bytes", "output_bytes",
+                          "temp_bytes", "total_bytes", "alias_saved_bytes",
+                          "dropped_donation_bytes", "replicated_leaves")
+            }
     fleet_summaries = [r for r in records if r.get("kind") == "fleet_summary"]
     fleet_summary = (
         {
@@ -292,6 +310,7 @@ def summarize(records: list[dict[str, Any]]) -> dict[str, Any]:
         "fleet_skew": fleet_skew,
         "fleet_incidents": fleet_incidents,
         "fleet_summary": fleet_summary,
+        "memory": memory,
     }
 
 
@@ -421,6 +440,18 @@ def main(argv: list[str] | None = None) -> int:
             f"{k}={v}" for k, v in sorted(summary["fleet_incidents"].items())
         )
         rows.append(("fleet incidents", by_event))
+    for entry, row in summary["memory"].items():
+        repl = row.get("replicated_leaves")
+        rows.append((
+            f"hbm {entry}",
+            f"{_fmt(row['total_bytes'])} B/device "
+            f"(arg {_fmt(row['argument_bytes'])}, out "
+            f"{_fmt(row['output_bytes'])}, temp {_fmt(row['temp_bytes'])}) "
+            f"on {_fmt(row['devices'])} dev, alias saved "
+            f"{_fmt(row['alias_saved_bytes'])} B, dropped donation "
+            f"{_fmt(row['dropped_donation_bytes'])} B"
+            + (f", {repl} REPLICATED leaf(s)" if repl else ""),
+        ))
     for wire, row in summary["sync_compare"].items():
         rows.append((
             f"overlap {wire}",

@@ -24,6 +24,10 @@ Python linter sees:
 - **GL008 dead-import** — module-level imports never used.
 - **GL009 blocking-sync-in-step-loop** — unconditional device fetches
   inside the host-side step loop.
+- **GL010 partition-spec-mismatch** — ``PartitionSpec`` axis names
+  absent from the module's mesh axis universe, and rank-impossible
+  specs naming one axis twice (the lint-side twin of graftmem's
+  TA009 implicit-reshard audit).
 
 The **graftrank** family (``analysis/rank.py``) audits the *cross-rank*
 invariants of the elastic multi-process runtime via rank-taint analysis

@@ -9,7 +9,9 @@ accepted residual and exits 0. ``--fix`` applies the safe auto-fixes
 
 ``python -m ...analysis trace [...]`` dispatches to graftcheck, the
 trace-audit suite over the registered step functions (TA001-TA006,
-``analysis/trace/cli.py``).
+``analysis/trace/cli.py``); ``python -m ...analysis memory [...]``
+dispatches to graftmem, the compiled-memory/sharding audits with the
+HBM budget gate (TA007-TA010, ``analysis/trace/memory.py``).
 
 ``--select``/``--disable`` take rule ids or bare family prefixes —
 ``--select GR`` runs every graftrank rule.
@@ -30,7 +32,7 @@ from cs744_pytorch_distributed_tutorial_tpu.analysis.rules import ALL_RULES
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="JAX/TPU-aware static analysis (GL001-GL009, GR001-GR005).",
+        description="JAX/TPU-aware static analysis (GL001-GL010, GR001-GR005).",
     )
     p.add_argument(
         "paths",
@@ -116,6 +118,14 @@ def main(argv: list[str] | None = None) -> int:
         )
 
         return trace_main(argv[1:])
+    if argv and argv[0] == "memory":
+        # graftmem: compiled-memory/sharding audits + HBM budget gate.
+        # Same lazy-import rule: the platform env must precede jax.
+        from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.memory import (
+            main as memory_main,
+        )
+
+        return memory_main(argv[1:])
     args = build_parser().parse_args(argv)
     if args.list_rules:
         for rid, fn in sorted(ALL_RULES.items()):

@@ -766,7 +766,12 @@ def _axis_literals(
         yield ctx.module_str_consts[node.id], node
 
 
-def _axis_universe(ctx: ModuleContext) -> set[str]:
+def _axis_universe(ctx: ModuleContext, include_specs: bool = True) -> set[str]:
+    """Axis names this module declares. With ``include_specs`` (GL005's
+    view) PartitionSpec/NamedSharding literals and in_specs/out_specs
+    count as declarations; without it (GL010's view) only MESH
+    constructions, axis_names/mesh_axes kwargs and ``*AXIS*`` constants
+    do — a spec literal must not justify itself."""
     universe: set[str] = {
         v for k, v in ctx.module_str_consts.items() if "AXIS" in k.upper()
     }
@@ -779,11 +784,13 @@ def _axis_universe(ctx: ModuleContext) -> set[str]:
         if tail in mesh_tails:
             for v in values:
                 universe |= _string_pool(v, dict_keys_only=isinstance(v, ast.Dict))
-        elif tail in spec_tails:
+        elif include_specs and tail in spec_tails:
             for v in values:
                 universe |= _string_pool(v)
         for kw in call.keywords:
-            if kw.arg in ("in_specs", "out_specs", "axis_names", "mesh_axes"):
+            if kw.arg in ("axis_names", "mesh_axes") or (
+                include_specs and kw.arg in ("in_specs", "out_specs")
+            ):
                 universe |= _string_pool(kw.value)
     return universe
 
@@ -1016,6 +1023,55 @@ def _under_cadence_gate(
     return False
 
 
+# ======================================================================= GL010
+def check_partition_spec_mismatch(ctx: ModuleContext) -> Iterator[Finding]:
+    """GL010 partition-spec-mismatch.
+
+    The lint-side twin of graftmem's TA009: a PartitionSpec axis that
+    does not exist on the mesh makes the partitioner either fail or
+    silently fall back to replication-plus-reshard at the next consumer.
+    Axis-name literals in ``PartitionSpec(...)`` calls (including the
+    specs inside ``in_specs``/``out_specs``) are checked against the
+    module's MESH axis universe — mesh constructions, axis_names/
+    mesh_axes kwargs and ``*AXIS*`` constants; unlike GL005, spec
+    literals do not self-justify. Rank-impossible specs — one axis name
+    in two positional entries of the same spec — are flagged even in
+    modules with no declared mesh: a mesh axis can shard at most one
+    dimension, against every mesh."""
+    rule, name = "GL010", "partition-spec-mismatch"
+    universe = _axis_universe(ctx, include_specs=False)
+    for call in ctx.calls:
+        dotted = ctx.resolve(call.func)
+        tail = dotted.rsplit(".", 1)[-1] if dotted else ""
+        if tail != "PartitionSpec":
+            continue
+        seen: set[str] = set()
+        for arg in call.args:
+            for value, lit in _axis_literals(ctx, arg):
+                if value in seen:
+                    yield _finding(
+                        ctx,
+                        lit,
+                        rule,
+                        name,
+                        f"PartitionSpec names axis '{value}' twice — a mesh "
+                        "axis can shard at most one dimension, so this spec "
+                        "is impossible on any mesh",
+                    )
+                seen.add(value)
+                if universe and value not in universe:
+                    yield _finding(
+                        ctx,
+                        lit,
+                        rule,
+                        name,
+                        f"PartitionSpec names axis '{value}' but this "
+                        f"module's meshes declare {sorted(universe)} — the "
+                        "partitioner will fail or silently replicate and "
+                        "reshard at the next consumer",
+                    )
+
+
 ALL_RULES: dict[str, RuleFn] = {
     "GL001": check_host_sync,
     "GL002": check_retrace_hazard,
@@ -1026,6 +1082,7 @@ ALL_RULES: dict[str, RuleFn] = {
     "GL007": check_time_in_trace,
     "GL008": check_dead_import,
     "GL009": check_blocking_sync_in_step_loop,
+    "GL010": check_partition_spec_mismatch,
 }
 
 # graftrank (GR001–GR005): cross-rank divergence and distributed-deadlock

@@ -4,11 +4,17 @@ Where graftlint (the AST linter one package up) reads source text, this
 subpackage audits the traced jaxpr and the lowered/compiled executable of
 the REAL train steps: dtype upcasts (TA001), dropped buffer donation
 (TA002), the collective schedule and bytes-on-wire of each sync strategy
-(TA003), closure-captured trace constants (TA004), and dead computation
-(TA005). Entry points self-register from the engine modules
-(``analysis/trace/registry.py``) and the CLI runs as::
+(TA003), closure-captured trace constants (TA004), dead computation
+(TA005), and branch-divergent collective schedules (TA006). The
+**graftmem** sibling (``analysis/trace/memory.py``) audits the compiled
+MEMORY plan over the same entry points: the per-device HBM ledger
+against a checked-in budget (TA007), silently replicated sharded state
+(TA008), partitioner-inserted reshards (TA009), and the bytes dropped
+donations cost (TA010). Entry points self-register from the engine
+modules (``analysis/trace/registry.py``) and the CLIs run as::
 
     python -m cs744_pytorch_distributed_tutorial_tpu.analysis trace
+    python -m cs744_pytorch_distributed_tutorial_tpu.analysis memory
 """
 
 from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (

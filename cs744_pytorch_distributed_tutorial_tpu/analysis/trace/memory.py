@@ -1,0 +1,715 @@
+"""graftmem: compiled-memory and sharding audits (TA007-TA010).
+
+========  ======================  =============================================
+rule      name                    what it catches
+========  ======================  =============================================
+TA007     hbm-budget-regression   per-entrypoint compiled ``memory_analysis()``
+                                  ledger (argument/output/temp/alias bytes per
+                                  device) exceeding the checked-in
+                                  ``benchmarks/memory_budget.json`` tolerance
+TA008     unintended-replication  a param/optimizer leaf the sync strategy
+                                  declares SHARDED (``sharded_param_paths``)
+                                  lowering fully replicated on a multi-device
+                                  mesh — every replica pays full HBM
+TA009     implicit-reshard        collective classes present in the compiled
+                                  HLO with no counterpart in the traced jaxpr:
+                                  resharding the SPMD partitioner inserted
+                                  behind the program's back (a spec mismatch
+                                  between producer and consumer shardings)
+TA010     donation-bytes-ledger   how many per-device bytes each dropped
+                                  donation costs (TA002 says "an alias was
+                                  dropped"; TA010 prices it)
+========  ======================  =============================================
+
+The gated quantity is ``total_bytes = argument + output + temp - alias``
+per device: the bytes the executable actually holds live, with
+donation-aliased outputs counted once. A dropped donation therefore
+inflates ``total_bytes`` too (less aliasing, more allocation), so the
+TA007 gate catches it even where TA010 is suppressed.
+
+graftmem deliberately has NO fingerprint baseline: the budget file IS
+its accepted state (``--write-budget`` regenerates it), and sharing
+``graftcheck_baseline.json`` would let a trace ``--write-baseline``
+clobber memory entries. Findings anchor to the same
+``register_entrypoint`` call sites as graftcheck, so inline pragmas
+(``# graftlint: disable=TA008 -- reason``) apply unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+import warnings
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from cs744_pytorch_distributed_tutorial_tpu.analysis.core import (
+    Finding,
+    Suppressions,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace import jaxpr_utils
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.audits import (
+    _ALIAS_BLOCK_RE,
+    _ALIAS_PARAM_RE,
+    _rel,
+)
+from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+    TraceEntry,
+    TracedStep,
+)
+
+MEMORY_RULES: dict[str, str] = {
+    "TA007": "hbm-budget-regression",
+    "TA008": "unintended-replication",
+    "TA009": "implicit-reshard",
+    "TA010": "donation-bytes-ledger",
+}
+
+DEFAULT_BUDGET = "benchmarks/memory_budget.json"
+#: relative tolerance band around each budgeted total, and the absolute
+#: floor under it — XLA scheduling jitter on tiny models is bytes-scale,
+#: but a floor keeps sub-64KiB noise from failing CI on small entries
+DEFAULT_TOLERANCE = 0.05
+DEFAULT_FLOOR_BYTES = 64 * 1024
+#: TA008 ignores leaves below this full (unsharded) size: scalar Adam
+#: counts, biases and norm scales are replicated by construction and
+#: cost nothing
+TA008_MIN_BYTES = 2048
+
+#: one compiled-HLO instruction whose opcode is a collective; matches the
+#: plain and async ``-start`` forms (the ``-done`` half of a pair fails
+#: the trailing paren and is not double-counted)
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s+(?:\([^=]*?\)\s+)?[a-z0-9\[\]{},\s]*?"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"(?:-start)?\("
+)
+
+#: canonical jaxpr collective class -> the HLO opcode class it lowers to
+_JAXPR_TO_HLO = {
+    "psum": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+}
+
+
+def _finding(entry: TraceEntry, rule: str, message: str) -> Finding:
+    return Finding(
+        path=_rel(entry.path),
+        line=entry.line,
+        col=1,
+        rule=rule,
+        name=MEMORY_RULES[rule],
+        message=f"[{entry.name}] {message}",
+    )
+
+
+def _leaf_bytes(leaf: Any, sharding: Any = None) -> int:
+    """Bytes of one input leaf; with ``sharding``, the PER-DEVICE bytes
+    (the shard shape's size). Extended dtypes (PRNG keys) fall back to a
+    4-byte itemsize like :func:`jaxpr_utils.aval_bytes`."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    if sharding is not None:
+        try:
+            shape = tuple(sharding.shard_shape(shape))
+        except (TypeError, ValueError):
+            pass
+    dtype = getattr(leaf, "dtype", None)
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = getattr(dtype, "itemsize", 4)
+    return int(math.prod(shape)) * itemsize
+
+
+def _leaf_desc(leaf: Any) -> str:
+    return f"{getattr(leaf, 'dtype', '?')}{tuple(getattr(leaf, 'shape', ()))}"
+
+
+def hlo_collective_counts(hlo_text: str) -> dict[str, int]:
+    """Collective-opcode instruction counts in a compiled module's HLO
+    text, by HLO class name."""
+    counts: dict[str, int] = {}
+    for cls in _HLO_COLLECTIVE_RE.findall(hlo_text):
+        counts[cls] = counts.get(cls, 0) + 1
+    return counts
+
+
+# ------------------------------------------------------------- measurement
+def measure_entry(entry: TraceEntry, step: TracedStep) -> dict[str, Any]:
+    """Lower and compile ``step`` ONCE and extract everything the memory
+    audits need: the ``memory_analysis()`` ledger, the donation/alias
+    sets priced per device, the compiled input shardings paired with the
+    flattened example args, and the HLO collective counts.
+
+    The returned dict's non-underscore keys are the JSON-safe ledger;
+    ``_``-prefixed keys carry live objects for the audits and are
+    stripped before reporting.
+    """
+    with warnings.catch_warnings():
+        # A dropped donation warns at compile time; TA002/TA010 report it
+        # as findings instead.
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        lowered = step.fn.lower(*step.args)
+        compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        raise RuntimeError("backend returned no memory_analysis()")
+    arg_b = int(ma.argument_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    temp_b = int(ma.temp_size_in_bytes)
+    alias_b = int(ma.alias_size_in_bytes)
+
+    pairs = jax.tree_util.tree_flatten_with_path(step.args)[0]
+    # Pair each arg leaf with its compiled input sharding. Aligned PER
+    # TOP-LEVEL ARGUMENT: an arg jit treats as static (the LM step
+    # counter) has an empty sharding tree, so its leaves pad with None
+    # rather than misaligning every later arg.
+    shardings: list[Any] | None
+    try:
+        arg_shardings = compiled.input_shardings[0]
+        assert len(arg_shardings) == len(step.args)
+        shardings = []
+        for arg, sh_tree in zip(step.args, arg_shardings):
+            n = len(jax.tree_util.tree_leaves(arg))
+            sh_leaves = jax.tree_util.tree_leaves(sh_tree)
+            shardings.extend(sh_leaves if len(sh_leaves) == n else [None] * n)
+    except Exception:  # backend without reflectable input shardings
+        shardings = None
+    if shardings is not None and len(shardings) != len(pairs):
+        shardings = None
+
+    infos = jax.tree_util.tree_leaves(lowered.args_info)
+    donated = {i for i, a in enumerate(infos) if getattr(a, "donated", False)}
+    header = compiled.as_text().splitlines()[0]
+    m = _ALIAS_BLOCK_RE.search(header)
+    aliased: set[int] = set()
+    if m is not None:
+        aliased = {int(p) for p in _ALIAS_PARAM_RE.findall(m.group(1))}
+    if aliased and max(aliased) >= len(pairs):
+        aliased = set()  # unmappable alias block; TA002 reports this case
+
+    def dev_bytes(i: int) -> int:
+        sh = shardings[i] if shardings is not None else None
+        return _leaf_bytes(pairs[i][1], sh)
+
+    dropped = sorted(donated - aliased)
+    saved_b = sum(dev_bytes(i) for i in sorted(donated & aliased))
+    dropped_b = sum(dev_bytes(i) for i in dropped)
+
+    ndev = 1
+    for size in step.axis_sizes.values():
+        ndev *= int(size)
+
+    ledger: dict[str, Any] = {
+        "entry": entry.name,
+        "devices": max(1, ndev),
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": temp_b,
+        "alias_bytes": alias_b,
+        "total_bytes": arg_b + out_b + temp_b - alias_b,
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "donated_leaves": len(donated),
+        "aliased_leaves": len(donated & aliased),
+        "alias_saved_bytes": int(saved_b),
+        "dropped_donation_bytes": int(dropped_b),
+        "replicated_leaves": 0,  # filled by TA008
+        "hlo_collectives": hlo_collective_counts(compiled.as_text()),
+        "_pairs": pairs,
+        "_shardings": shardings,
+        "_dropped": dropped,
+    }
+    return ledger
+
+
+# ---------------------------------------------------------------------- TA007
+def audit_budget(
+    entry: TraceEntry,
+    step: TracedStep,
+    ledger: dict[str, Any],
+    budget: dict[str, Any],
+) -> list[Finding]:
+    """Compare the measured per-device ledger against the checked-in
+    budget. Gate on ``total_bytes`` only — the components are recorded so
+    a regression message can say WHICH part grew, but gating each one
+    would triple-fire a single cause."""
+    entries = budget.get("entries", {})
+    b = entries.get(entry.name)
+    if b is None:
+        return [
+            _finding(
+                entry,
+                "TA007",
+                f"no HBM budget entry for '{entry.name}' in "
+                f"{budget.get('_path', DEFAULT_BUDGET)} — run "
+                f"`analysis memory --write-budget` to record one",
+            )
+        ]
+    out: list[Finding] = []
+    if int(b.get("devices", ledger["devices"])) != ledger["devices"]:
+        out.append(
+            _finding(
+                entry,
+                "TA007",
+                f"budget was recorded for {b.get('devices')} device(s) but "
+                f"this audit compiled for {ledger['devices']} — the "
+                f"per-device ledger is not comparable; rerun "
+                f"`analysis memory --write-budget`",
+            )
+        )
+        return out
+    budget_total = int(b["total_bytes"])
+    tol = max(
+        float(budget.get("tolerance", DEFAULT_TOLERANCE)) * budget_total,
+        float(budget.get("floor_bytes", DEFAULT_FLOOR_BYTES)),
+    )
+    measured = int(ledger["total_bytes"])
+    if measured > budget_total + tol:
+        deltas = ", ".join(
+            f"{k.split('_')[0]} {ledger[k] - int(b.get(k, ledger[k])):+d}B"
+            for k in (
+                "argument_bytes",
+                "output_bytes",
+                "temp_bytes",
+                "alias_bytes",
+            )
+        )
+        out.append(
+            _finding(
+                entry,
+                "TA007",
+                f"per-device HBM total {measured}B exceeds the budget "
+                f"{budget_total}B by {measured - budget_total:+d}B "
+                f"(> {int(tol)}B tolerance; components: {deltas}) — if the "
+                f"growth is intentional, rerun "
+                f"`analysis memory --write-budget`",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------- TA008
+def audit_replication(
+    entry: TraceEntry,
+    step: TracedStep,
+    ledger: dict[str, Any],
+    min_bytes: int = TA008_MIN_BYTES,
+) -> list[Finding]:
+    """Flag input leaves the engine DECLARES sharded (zero1 optimizer
+    state, fsdp params — ``step.sharded_param_paths`` keystr prefixes)
+    whose compiled input sharding is fully replicated on a multi-device
+    mesh. A silently replicated optimizer shard costs ``(n-1)/n`` of its
+    bytes on every device for zero benefit."""
+    prefixes = tuple(step.sharded_param_paths)
+    shardings = ledger["_shardings"]
+    ndev = ledger["devices"]
+    if not prefixes or ndev <= 1 or shardings is None:
+        return []
+    out: list[Finding] = []
+    hits = 0
+    for (path, leaf), sh in zip(ledger["_pairs"], shardings):
+        ks = jax.tree_util.keystr(path)
+        if not any(ks.startswith(p) for p in prefixes):
+            continue
+        nbytes = _leaf_bytes(leaf)
+        if nbytes < min_bytes:
+            continue
+        if getattr(sh, "is_fully_replicated", False) and len(sh.device_set) > 1:
+            hits += 1
+            out.append(
+                _finding(
+                    entry,
+                    "TA008",
+                    f"input leaf {ks} ({_leaf_desc(leaf)}, {nbytes}B) "
+                    f"lowers fully REPLICATED across "
+                    f"{len(sh.device_set)} devices, but sync="
+                    f"'{step.sync}' declares it sharded — every replica "
+                    f"silently pays the full buffer",
+                )
+            )
+    ledger["replicated_leaves"] = hits
+    return out
+
+
+# ---------------------------------------------------------------------- TA009
+def audit_implicit_reshard(
+    entry: TraceEntry,
+    step: TracedStep,
+    closed_jaxpr,
+    ledger: dict[str, Any],
+) -> list[Finding]:
+    """Collective CLASSES in the compiled HLO that the traced jaxpr never
+    binds: communication the SPMD partitioner inserted to fix up a
+    producer/consumer sharding mismatch. Classes (not counts) are
+    compared — XLA legitimately fuses and splits collectives, but it
+    never invents a new KIND of collective unless it had to reshard."""
+    collectives = jaxpr_utils.collect_collectives(closed_jaxpr, step.axis_sizes)
+    jaxpr_classes = {
+        _JAXPR_TO_HLO[c.cls] for c in collectives if c.cls in _JAXPR_TO_HLO
+    }
+    out: list[Finding] = []
+    for cls, n in sorted(ledger["hlo_collectives"].items()):
+        if cls in jaxpr_classes:
+            continue
+        out.append(
+            _finding(
+                entry,
+                "TA009",
+                f"compiled HLO contains {n}x {cls} with no {cls}-class "
+                f"collective in the traced jaxpr — the SPMD partitioner "
+                f"inserted a reshard behind the program's back (check the "
+                f"in/out specs of the op feeding it)",
+            )
+        )
+    return out
+
+
+# ---------------------------------------------------------------------- TA010
+def audit_donation_bytes(
+    entry: TraceEntry, step: TracedStep, ledger: dict[str, Any]
+) -> list[Finding]:
+    """Price the donations TA002 flags: one finding per entry totalling
+    the per-device bytes its dropped donations double-allocate, naming
+    the worst offenders."""
+    dropped = ledger["_dropped"]
+    if not dropped or not step.check_donation:
+        return []
+    pairs = ledger["_pairs"]
+    shardings = ledger["_shardings"]
+
+    def dev_bytes(i: int) -> int:
+        sh = shardings[i] if shardings is not None else None
+        return _leaf_bytes(pairs[i][1], sh)
+
+    worst = sorted(dropped, key=dev_bytes, reverse=True)[:3]
+    names = ", ".join(
+        f"{jax.tree_util.keystr(pairs[i][0])} "
+        f"({_leaf_desc(pairs[i][1])}, {dev_bytes(i)}B)"
+        for i in worst
+    )
+    return [
+        _finding(
+            entry,
+            "TA010",
+            f"{len(dropped)} dropped donation(s) double-allocate "
+            f"{ledger['dropped_donation_bytes']}B per device; worst: "
+            f"{names}",
+        )
+    ]
+
+
+# ------------------------------------------------------------------ budget IO
+def load_budget(path: str | Path) -> dict[str, Any]:
+    """Parse the budget file; a missing file is an EMPTY budget (every
+    entry then raises a TA007 missing-entry finding), a malformed one
+    raises ``ValueError``."""
+    p = Path(path)
+    if not p.is_file():
+        return {
+            "version": 1,
+            "tolerance": DEFAULT_TOLERANCE,
+            "floor_bytes": DEFAULT_FLOOR_BYTES,
+            "entries": {},
+            "_path": p.as_posix(),
+        }
+    data = json.loads(p.read_text())
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(f"budget file {p} has no 'entries' object")
+    data.setdefault("tolerance", DEFAULT_TOLERANCE)
+    data.setdefault("floor_bytes", DEFAULT_FLOOR_BYTES)
+    data["_path"] = p.as_posix()
+    return data
+
+
+def _budget_entry(ledger: dict[str, Any]) -> dict[str, Any]:
+    return {
+        "devices": ledger["devices"],
+        "argument_bytes": ledger["argument_bytes"],
+        "output_bytes": ledger["output_bytes"],
+        "temp_bytes": ledger["temp_bytes"],
+        "alias_bytes": ledger["alias_bytes"],
+        "total_bytes": ledger["total_bytes"],
+        "dropped_donation_bytes": ledger["dropped_donation_bytes"],
+    }
+
+
+def write_budget(
+    path: str | Path, ledgers: list[dict[str, Any]]
+) -> int:
+    """Record ``ledgers`` into the budget file, merging over any existing
+    entries (auditing a subset must not drop the rest's budgets)."""
+    p = Path(path)
+    try:
+        existing = load_budget(p)
+    except ValueError:
+        existing = {"entries": {}}
+    entries = dict(existing.get("entries", {}))
+    for ledger in ledgers:
+        entries[ledger["entry"]] = _budget_entry(ledger)
+    payload = {
+        "version": 1,
+        "tolerance": existing.get("tolerance", DEFAULT_TOLERANCE),
+        "floor_bytes": existing.get("floor_bytes", DEFAULT_FLOOR_BYTES),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+    p.write_text(json.dumps(payload, indent=2) + "\n")
+    return len(entries)
+
+
+# ---------------------------------------------------------------- entry audit
+def audit_memory_entry(
+    entry: TraceEntry,
+    rules: set[str] | None = None,
+    budget: dict[str, Any] | None = None,
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Run every selected graftmem rule against one entry. ``budget``
+    None skips TA007 entirely (fixture tests and ``--no-budget`` runs
+    should not fire missing-entry findings). Returns raw (unsuppressed)
+    findings plus the JSON-safe ledger."""
+    active = set(MEMORY_RULES) if rules is None else rules
+    step = entry.build()
+    ledger = measure_entry(entry, step)
+    findings: list[Finding] = []
+    if "TA008" in active:
+        findings += audit_replication(entry, step, ledger)
+    if "TA009" in active:
+        closed_jaxpr = jax.make_jaxpr(step.fn)(*step.args)
+        findings += audit_implicit_reshard(entry, step, closed_jaxpr, ledger)
+    if "TA010" in active:
+        findings += audit_donation_bytes(entry, step, ledger)
+    if "TA007" in active and budget is not None:
+        findings += audit_budget(entry, step, ledger, budget)
+    ledger = {k: v for k, v in ledger.items() if not k.startswith("_")}
+    ledger["findings"] = len(findings)
+    return findings, ledger
+
+
+def run_memory_audits(
+    entries: list[TraceEntry],
+    rules: set[str] | None = None,
+    budget: dict[str, Any] | None = None,
+) -> tuple[list[Finding], int, list[dict[str, Any]], dict[str, str], list[str]]:
+    """Audit all ``entries``; same shape and suppression semantics as
+    ``audits.run_audits`` — (findings, suppressed_count, ledgers,
+    sources, errors), with pragmas read from each entry's anchor file."""
+    findings: list[Finding] = []
+    suppressed = 0
+    ledgers: list[dict[str, Any]] = []
+    sources: dict[str, str] = {}
+    errors: list[str] = []
+    for entry in entries:
+        try:
+            raw, ledger = audit_memory_entry(entry, rules, budget)
+        except Exception as exc:
+            errors.append(f"{entry.name}: {type(exc).__name__}: {exc}")
+            continue
+        rel = _rel(entry.path)
+        if rel not in sources and os.path.exists(entry.path):
+            sources[rel] = Path(entry.path).read_text()
+        supp = Suppressions(sources.get(rel, ""))
+        kept = [f for f in raw if not supp.is_suppressed(f)]
+        suppressed += len(raw) - len(kept)
+        findings += kept
+        ledgers.append(ledger)
+    return findings, suppressed, ledgers, sources, errors
+
+
+def ledger_records(ledgers: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Flat ``kind: memory_ledger`` rows for ``metrics_summary.py`` —
+    the same record shape the perf/serve harnesses emit."""
+    keep = (
+        "entry",
+        "devices",
+        "argument_bytes",
+        "output_bytes",
+        "temp_bytes",
+        "alias_bytes",
+        "total_bytes",
+        "alias_saved_bytes",
+        "dropped_donation_bytes",
+        "replicated_leaves",
+    )
+    return [
+        {"kind": "memory_ledger", **{k: lg[k] for k in keep}}
+        for lg in ledgers
+    ]
+
+
+# ------------------------------------------------------------------------ CLI
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftmem",
+        description="compiled-memory & sharding audits (TA007-TA010).",
+    )
+    p.add_argument(
+        "entries",
+        nargs="*",
+        help="entrypoint names to audit (default: all registered)",
+    )
+    p.add_argument(
+        "--list-entrypoints",
+        action="store_true",
+        help="list registered entrypoints and exit",
+    )
+    p.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    p.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated TA rule ids to run (default: all)",
+    )
+    p.add_argument(
+        "--disable", default=None, help="comma-separated TA rule ids to skip"
+    )
+    p.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    p.add_argument(
+        "--report",
+        default=None,
+        help="also write the full JSON report to this file",
+    )
+    p.add_argument(
+        "--budget",
+        default=None,
+        help=f"HBM budget file for TA007 (default: {DEFAULT_BUDGET})",
+    )
+    p.add_argument(
+        "--no-budget",
+        action="store_true",
+        help="skip the TA007 budget gate entirely",
+    )
+    p.add_argument(
+        "--write-budget",
+        action="store_true",
+        help="record the measured ledgers as the accepted budget and exit 0",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.cli import (
+        _configure_platform,
+    )
+
+    _configure_platform()
+    from cs744_pytorch_distributed_tutorial_tpu.analysis.trace.registry import (
+        get_entrypoints,
+        load_builtin_entrypoints,
+    )
+
+    if args.list_rules:
+        for rid, name in sorted(MEMORY_RULES.items()):
+            print(f"{rid}  {name}")
+        return 0
+
+    rules = set(MEMORY_RULES)
+    for flag, keep in ((args.select, True), (args.disable, False)):
+        if not flag:
+            continue
+        named: set[str] = set()
+        unknown: set[str] = set()
+        for token in flag.split(","):
+            rid = token.strip().upper()
+            if not rid:
+                continue
+            if rid in MEMORY_RULES:
+                named.add(rid)
+            elif any(k.startswith(rid) for k in MEMORY_RULES):
+                # bare family prefix ("TA") selects the whole family
+                named.update(k for k in MEMORY_RULES if k.startswith(rid))
+            else:
+                unknown.add(rid)
+        if unknown:
+            print(
+                f"graftmem: unknown rule(s): {sorted(unknown)}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = rules & named if keep else rules - named
+
+    load_builtin_entrypoints()
+    try:
+        entries = get_entrypoints(args.entries or None)
+    except KeyError as e:
+        print(f"graftmem: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.list_entrypoints:
+        for entry in entries:
+            tags = f" [{','.join(entry.tags)}]" if entry.tags else ""
+            print(f"{entry.name}  {entry.path}:{entry.line}{tags}")
+        return 0
+
+    budget_path = args.budget or DEFAULT_BUDGET
+    budget: dict[str, Any] | None = None
+    if not args.no_budget and not args.write_budget:
+        try:
+            budget = load_budget(budget_path)
+        except (ValueError, OSError) as e:
+            print(f"graftmem: bad budget {budget_path}: {e}", file=sys.stderr)
+            return 2
+
+    findings, suppressed, ledgers, _sources, errors = run_memory_audits(
+        entries, rules, budget
+    )
+
+    if args.write_budget:
+        if errors:
+            for err in errors:
+                print(f"error: {err}")
+            return 1
+        n = write_budget(budget_path, ledgers)
+        print(f"graftmem: wrote {n} budget entr(ies) to {budget_path}")
+        return 0
+
+    exit_code = 1 if (findings or errors) else 0
+    payload = {
+        "findings": [f.as_dict() for f in findings],
+        "suppressed": suppressed,
+        "entries": ledgers,
+        "records": ledger_records(ledgers),
+        "errors": errors,
+        "exit_code": exit_code,
+    }
+    if args.report:
+        Path(args.report).write_text(json.dumps(payload, indent=2) + "\n")
+    if args.format == "json":
+        print(json.dumps(payload, indent=2))
+        return exit_code
+
+    for f in findings:
+        print(f.text())
+    for err in errors:
+        print(f"error: {err}")
+    bits = [
+        f"{len(ledgers)} entrypoint(s) measured",
+        f"{len(findings)} finding(s)",
+    ]
+    if suppressed:
+        bits.append(f"{suppressed} suppressed")
+    if errors:
+        bits.append(f"{len(errors)} error(s)")
+    print("graftmem: " + ", ".join(bits))
+    return exit_code
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -53,6 +53,11 @@ class TracedStep:
     expected_wire_bytes: float | None = None
     #: whether this step donates buffers (enables TA002)
     check_donation: bool = True
+    #: ``jax.tree_util.keystr`` prefixes of input leaves the sync
+    #: strategy promises to SHARD (zero1 optimizer state, fsdp params);
+    #: graftmem's TA008 flags any matching leaf whose compiled input
+    #: sharding is fully replicated on a multi-device mesh
+    sharded_param_paths: tuple[str, ...] = ()
     #: extra context echoed into the JSON report
     detail: dict[str, Any] = dataclasses.field(default_factory=dict)
 

@@ -1535,6 +1535,15 @@ def make_trace_entry(**overrides):
         bucket_bytes=trainer._bucket_bytes,
         overlap=trainer._overlap,
     )
+    # graftmem TA008 contract: which input leaves the sync strategy
+    # promises to shard. _state_specs shards opt_state under zero1/fsdp
+    # and params under fsdp (state is arg 0 of train_step).
+    if trainer._fsdp:
+        sharded_paths = ("[0].params", "[0].opt_state")
+    elif trainer._zero1:
+        sharded_paths = ("[0].opt_state",)
+    else:
+        sharded_paths = ()
     return TracedStep(
         name="cifar",
         fn=trainer.train_step,
@@ -1546,6 +1555,7 @@ def make_trace_entry(**overrides):
         expected_schedule=schedule,
         expected_wire_bytes=float(wire_bytes),
         check_donation=True,
+        sharded_param_paths=sharded_paths,
         detail={
             "model": cfg.model,
             "accum_steps": cfg.accum_steps,

@@ -1719,6 +1719,14 @@ def make_lm_trace_entry(**overrides):
         bucket_bytes=trainer._bucket_bytes,
         overlap=trainer._overlap,
     )
+    # graftmem TA008 contract: fsdp shards params AND optimizer moments
+    # (args 0 and 1 of jitted_train_step); zero1 shards the moments only.
+    if dp_strategy == "fsdp":
+        sharded_paths: tuple[str, ...] = ("[0]", "[1]")
+    elif dp_strategy == "zero1":
+        sharded_paths = ("[1]",)
+    else:
+        sharded_paths = ()
     return TracedStep(
         name="lm",
         fn=trainer.jitted_train_step,
@@ -1730,6 +1738,7 @@ def make_lm_trace_entry(**overrides):
         expected_schedule=schedule,
         expected_wire_bytes=float(wire_bytes),
         check_donation=True,
+        sharded_param_paths=sharded_paths,
         detail={
             "layers": cfg.num_layers,
             "d_model": cfg.d_model,

@@ -531,6 +531,72 @@ def test_gl009_clean_cadence_gated_and_no_jit():
     )
 
 
+# ---------------------------------------------------------------- GL010
+def test_gl010_axis_absent_from_mesh_universe():
+    hits = run(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec
+
+        mesh = Mesh(jax.devices(), ("data",))
+        SPEC = PartitionSpec("modle")
+        """,
+        "GL010",
+    )
+    assert len(hits) == 1
+    assert "'modle'" in hits[0].message and "'data'" in hits[0].message
+
+
+def test_gl010_duplicate_axis_flagged_without_any_mesh():
+    # rank-impossible against EVERY mesh, so no declared mesh is needed
+    hits = run(
+        """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("data", "data")
+        """,
+        "GL010",
+    )
+    assert len(hits) == 1 and "twice" in hits[0].message
+
+
+def test_gl010_fires_exactly_alone():
+    src = """
+    import jax
+    from jax.sharding import Mesh, PartitionSpec
+
+    mesh = Mesh(jax.devices(), ("data",))
+    SPEC = PartitionSpec("modle")
+    """
+    findings, _ = lint_source(textwrap.dedent(src))
+    assert {f.rule for f in findings} == {"GL010"}
+
+
+def test_gl010_clean_specs_and_gated_without_mesh():
+    # valid axes (incl. None placeholders) pass; and with NO mesh in the
+    # module the unknown-axis check stays silent — spec literals alone
+    # prove nothing about the mesh they will meet at runtime
+    assert not run(
+        """
+        import jax
+        from jax.sharding import Mesh, PartitionSpec
+
+        mesh = Mesh(jax.devices(), ("data", "model"))
+        S1 = PartitionSpec("data", "model")
+        S2 = PartitionSpec(None, "data")
+        """,
+        "GL010",
+    )
+    assert not run(
+        """
+        from jax.sharding import PartitionSpec
+
+        SPEC = PartitionSpec("anything")
+        """,
+        "GL010",
+    )
+
+
 # ---------------------------------------------------------- suppressions
 def test_trailing_suppression_silences_same_line():
     src = textwrap.dedent(

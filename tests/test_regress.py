@@ -125,3 +125,23 @@ def test_metrics_summary_phase_rows():
     assert s["phases"]["grad_sync"]["roofline"] == "comms"
     assert s["sync_exposed_ms"] == 0.75
     assert s["final_loss"] == 2.0  # step reduction unaffected
+
+
+def test_metrics_summary_memory_ledger_rows():
+    """metrics_summary renders graftmem memory_report.json ledgers as
+    one hbm row per entrypoint, latest record per entry winning."""
+    ledger = {
+        "kind": "memory_ledger", "entry": "cifar", "devices": 4,
+        "argument_bytes": 118332, "output_bytes": 93964,
+        "temp_bytes": 2558400, "total_bytes": 2676980,
+        "alias_saved_bytes": 93716, "dropped_donation_bytes": 0,
+        "replicated_leaves": 0,
+    }
+    stale = dict(ledger, total_bytes=1)
+    s = metrics_summary.summarize([stale, ledger])
+    assert s["memory"]["cifar"]["total_bytes"] == 2676980
+    assert s["memory"]["cifar"]["devices"] == 4
+    # a replicated leaf count survives into the summary for the renderer
+    leaky = dict(ledger, entry="lm", replicated_leaves=2)
+    s = metrics_summary.summarize([leaky])
+    assert s["memory"]["lm"]["replicated_leaves"] == 2

@@ -603,6 +603,60 @@ def test_cli_dispatch_from_analysis_main(capsys):
     assert "TA001" in capsys.readouterr().out
 
 
+def _upcast_step() -> TracedStep:
+    """Trace-only step with a seeded bf16->f32 matmul upcast (TA001)."""
+    w = jnp.ones((16, 16), jnp.bfloat16)
+
+    def _fn(x):
+        h = jnp.dot(x, w)
+        return jnp.dot(
+            h.astype(jnp.float32), jnp.eye(16, dtype=jnp.float32)
+        ).sum()
+
+    return TracedStep(
+        name="seeded",
+        fn=_fn,
+        args=(jnp.ones((8, 16), jnp.bfloat16),),
+        axis_sizes={},
+        compute_dtype="bfloat16",
+        check_donation=False,
+    )
+
+
+def test_cli_write_baseline_roundtrip(tmp_path, capsys):
+    """--write-baseline records current findings; a rerun against that
+    baseline passes; --no-baseline surfaces them again."""
+    step = _upcast_step()
+    register_entrypoint("seeded-baseline", lambda: step)
+    bl = tmp_path / "graftcheck_baseline.json"
+    sel = ["seeded-baseline", "--select", "TA001", "--baseline", str(bl)]
+
+    assert trace_cli_main(sel + ["--no-baseline"]) == 1  # finding is live
+    capsys.readouterr()
+
+    assert trace_cli_main(sel + ["--write-baseline"]) == 0
+    assert "wrote 1 baseline entr" in capsys.readouterr().out
+    assert json.loads(bl.read_text())["entries"]
+
+    assert trace_cli_main(sel) == 0  # baselined now
+    assert "1 baselined" in capsys.readouterr().out
+
+    assert trace_cli_main(sel + ["--no-baseline"]) == 1  # still reportable
+
+
+def test_checked_in_baseline_is_valid_and_empty():
+    """The repo ships an EMPTY accepted-findings file: the default
+    ``--baseline`` path must load and suppress nothing."""
+    import pathlib
+
+    from cs744_pytorch_distributed_tutorial_tpu.analysis import Baseline
+
+    p = pathlib.Path(__file__).resolve().parent.parent / "graftcheck_baseline.json"
+    data = json.loads(p.read_text())
+    assert data == {"version": 1, "entries": []}
+    assert Baseline.load(p) is not None
+
+
 # ================================================ TA006 branch divergence
 def _cond_entry(mesh4, sync_branch, skip_branch):
     def step(x):
